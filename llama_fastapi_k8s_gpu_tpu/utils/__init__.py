@@ -1,0 +1,1 @@
+from .config import Settings, get_settings  # noqa: F401

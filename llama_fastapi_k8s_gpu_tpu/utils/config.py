@@ -1,0 +1,81 @@
+"""Env-overridable runtime settings.
+
+The reference hardcodes all of these as module constants (reference
+api.py:13-19: model dir ``models``, ``MODEL_NAME``, ``MAX_CONTEXT_TOKENS=1024``,
+``TIMEOUT_SECONDS=25``, ``MAX_QUEUE_SIZE=5``) and its Helm values never reach
+the app as env vars (SURVEY.md §5 "Config / flag system").  Here the same
+defaults are preserved, but every knob can be overridden through the
+environment so the Helm chart can parameterize the app.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def _env(name: str, default, cast=str):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class Settings:
+    # Identical defaults to reference api.py:13-19.
+    model_dir: str = "models"
+    model_name: str = "Lexi-Llama-3-8B-Uncensored_Q4_K_M.gguf"
+    max_context_tokens: int = 1024
+    timeout_seconds: float = 25.0
+    max_queue_size: int = 5
+
+    # Fixed sampling parameters the reference passes at api.py:59-62; the
+    # remaining knobs take llama-cpp-python 0.2.77 defaults (top_k=40,
+    # min_p=0.05, repeat_penalty=1.1) because the reference omits them.
+    temperature: float = 1.2
+    top_p: float = 0.9
+    frequency_penalty: float = 0.7
+    presence_penalty: float = 0.8
+    top_k: int = 40
+    min_p: float = 0.05
+    repeat_penalty: float = 1.1
+
+    # TPU-native knobs (no reference equivalent).
+    max_gen_tokens: int = 512
+    decode_chunk: int = 8           # device-side tokens per host round-trip
+    prefill_buckets: str = "128,256,512,1024"  # padded prompt shapes to bound recompiles
+    weight_format: str = "auto"     # auto | bf16 | int8 | q4k
+    host_platform: str = ""         # force JAX_PLATFORMS for tests ("cpu")
+
+    @property
+    def model_path(self) -> str:
+        return os.path.join(self.model_dir, self.model_name)
+
+    @property
+    def prefill_bucket_list(self) -> list[int]:
+        return sorted(int(x) for x in self.prefill_buckets.split(",") if x.strip())
+
+
+def get_settings() -> Settings:
+    return Settings(
+        model_dir=_env("LFKT_MODEL_DIR", Settings.model_dir),
+        model_name=_env("LFKT_MODEL_NAME", Settings.model_name),
+        max_context_tokens=_env("LFKT_MAX_CONTEXT_TOKENS", Settings.max_context_tokens, int),
+        timeout_seconds=_env("LFKT_TIMEOUT_SECONDS", Settings.timeout_seconds, float),
+        max_queue_size=_env("LFKT_MAX_QUEUE_SIZE", Settings.max_queue_size, int),
+        temperature=_env("LFKT_TEMPERATURE", Settings.temperature, float),
+        top_p=_env("LFKT_TOP_P", Settings.top_p, float),
+        frequency_penalty=_env("LFKT_FREQUENCY_PENALTY", Settings.frequency_penalty, float),
+        presence_penalty=_env("LFKT_PRESENCE_PENALTY", Settings.presence_penalty, float),
+        top_k=_env("LFKT_TOP_K", Settings.top_k, int),
+        min_p=_env("LFKT_MIN_P", Settings.min_p, float),
+        repeat_penalty=_env("LFKT_REPEAT_PENALTY", Settings.repeat_penalty, float),
+        max_gen_tokens=_env("LFKT_MAX_GEN_TOKENS", Settings.max_gen_tokens, int),
+        decode_chunk=_env("LFKT_DECODE_CHUNK", Settings.decode_chunk, int),
+        prefill_buckets=_env("LFKT_PREFILL_BUCKETS", Settings.prefill_buckets),
+        weight_format=_env("LFKT_WEIGHT_FORMAT", Settings.weight_format),
+        host_platform=_env("LFKT_HOST_PLATFORM", Settings.host_platform),
+    )

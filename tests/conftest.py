@@ -1,0 +1,17 @@
+"""Test harness config.
+
+Force JAX onto the XLA-CPU backend with 8 virtual devices BEFORE jax is
+imported anywhere, so model/sharding tests run without TPU hardware
+(SURVEY.md §4 "Device tests"). Multi-chip logic is exercised on the virtual
+device mesh exactly as the driver's dryrun does.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

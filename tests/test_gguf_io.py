@@ -1,0 +1,87 @@
+"""GGUF container reader/writer round-trip tests (SURVEY.md §4 "Unit": GGUF
+parser against hand-built tiny GGUF files)."""
+
+import numpy as np
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.gguf import GGMLType, GGUFFile, GGUFWriter
+from llama_fastapi_k8s_gpu_tpu.gguf.constants import GGUFValueType
+
+rng = np.random.default_rng(1)
+
+
+def test_metadata_roundtrip(tmp_path):
+    p = str(tmp_path / "meta.gguf")
+    w = GGUFWriter(p)
+    w.add_metadata("general.architecture", "llama")
+    w.add_metadata("general.name", "tiny")
+    w.add_metadata("llama.block_count", 2)
+    w.add_metadata("llama.rope.freq_base", 500000.0)
+    w.add_metadata("tokenizer.ggml.tokens", ["a", "b", "<|eot_id|>"])
+    w.add_metadata("tokenizer.ggml.token_type", [1, 1, 3])
+    w.add_metadata("tokenizer.ggml.scores", [0.0, -1.0, -2.0])
+    w.add_metadata("some.flag", True)
+    w.add_metadata("some.signed", -7, GGUFValueType.INT32)
+    w.write()
+
+    f = GGUFFile(p)
+    assert f.version == 3
+    assert f.architecture == "llama"
+    assert f.metadata["general.name"] == "tiny"
+    assert f.metadata["llama.block_count"] == 2
+    assert f.metadata["llama.rope.freq_base"] == pytest.approx(500000.0)
+    assert f.metadata["tokenizer.ggml.tokens"] == ["a", "b", "<|eot_id|>"]
+    assert f.metadata["tokenizer.ggml.token_type"] == [1, 1, 3]
+    assert f.metadata["tokenizer.ggml.scores"] == [0.0, -1.0, -2.0]
+    assert f.metadata["some.flag"] is True
+    assert f.metadata["some.signed"] == -7
+    assert f.hparam("block_count") == 2
+
+
+def test_tensor_roundtrip(tmp_path):
+    p = str(tmp_path / "tensors.gguf")
+    w = GGUFWriter(p)
+    w.add_metadata("general.architecture", "llama")
+    a = rng.standard_normal((8, 256)).astype(np.float32)   # (out, in)
+    b = rng.standard_normal((512,)).astype(np.float32)
+    c = rng.standard_normal((4, 512)).astype(np.float32)
+    w.add_tensor("a.weight", a, GGMLType.F32)
+    w.add_tensor("b.weight", b, GGMLType.Q8_0)
+    w.add_tensor("c.weight", c, GGMLType.Q4_K)
+    w.write()
+
+    f = GGUFFile(p)
+    assert set(f.tensors) == {"a.weight", "b.weight", "c.weight"}
+    ta = f["a.weight"]
+    assert ta.shape == (256, 8)  # ggml order: innermost first
+    np.testing.assert_array_equal(ta.astype_f32(), a)
+    tb = f["b.weight"].astype_f32()
+    assert np.sqrt(np.mean((tb - b) ** 2)) < 0.02
+    tc = f["c.weight"].astype_f32()
+    assert tc.shape == (4, 512)
+    assert np.sqrt(np.mean((tc - c) ** 2)) / np.sqrt(np.mean(c**2)) < 0.15
+
+
+def test_alignment_and_offsets(tmp_path):
+    p = str(tmp_path / "align.gguf")
+    w = GGUFWriter(p)
+    w.add_metadata("general.architecture", "llama")
+    # 3 tensors whose raw sizes are not multiples of the 32B alignment
+    arrays = [rng.standard_normal((1, 32)).astype(np.float32) for _ in range(3)]
+    for i, a in enumerate(arrays):
+        w.add_tensor(f"t{i}", a, GGMLType.Q8_0)  # 34 bytes each
+    w.write()
+    f = GGUFFile(p)
+    assert f.data_offset % 32 == 0
+    for i, a in enumerate(arrays):
+        t = f[f"t{i}"]
+        assert t.offset % 32 == 0
+        got = t.astype_f32()
+        assert np.allclose(got, a, atol=0.05)
+
+
+def test_bad_magic(tmp_path):
+    p = tmp_path / "bad.gguf"
+    p.write_bytes(b"NOPE" + b"\x00" * 64)
+    with pytest.raises(ValueError, match="not a GGUF"):
+        GGUFFile(str(p))

@@ -1,0 +1,204 @@
+"""Bit-exactness tests for the GGML quant codecs.
+
+Strategy (SURVEY.md §4 "Unit"): each vectorized numpy dequant in
+``gguf/quants.py`` is checked against an *independent scalar* re-implementation
+of the llama.cpp block layouts written here with explicit loops, over random
+raw blocks (valid by construction).  Quantize→dequantize round-trips are
+checked against analytic error bounds.
+"""
+
+import numpy as np
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.gguf import GGMLType, quants
+from llama_fastapi_k8s_gpu_tpu.gguf.constants import GGML_BLOCK_SIZES
+
+rng = np.random.default_rng(0)
+
+
+def _f16(lo, hi):
+    return np.frombuffer(bytes([lo, hi]), dtype=np.float16)[0].astype(np.float32)
+
+
+def _rand_f16_bytes(n):
+    # random but finite/small half-precision scales
+    vals = rng.uniform(-2, 2, size=n).astype(np.float16)
+    return vals.view(np.uint8).reshape(n, 2)
+
+
+def _get_scale_min_k4(j, q):
+    if j < 4:
+        return q[j] & 63, q[j + 4] & 63
+    return (
+        (q[j + 4] & 0x0F) | ((q[j - 4] >> 6) << 4),
+        (q[j + 4] >> 4) | ((q[j] >> 6) << 4),
+    )
+
+
+def scalar_dequant_q8_0(raw):
+    out = []
+    for blk in raw.reshape(-1, 34):
+        d = _f16(blk[0], blk[1])
+        q = blk[2:].view(np.int8)
+        out.extend(float(d) * float(x) for x in q)
+    return np.array(out, dtype=np.float32)
+
+
+def scalar_dequant_q4_0(raw):
+    out = []
+    for blk in raw.reshape(-1, 18):
+        d = _f16(blk[0], blk[1])
+        qs = blk[2:]
+        vals = [0.0] * 32
+        for l in range(16):
+            vals[l] = float(d) * ((int(qs[l]) & 0x0F) - 8)
+            vals[l + 16] = float(d) * ((int(qs[l]) >> 4) - 8)
+        out.extend(vals)
+    return np.array(out, dtype=np.float32)
+
+
+def scalar_dequant_q4_k(raw):
+    out = []
+    for blk in raw.reshape(-1, 144):
+        d = _f16(blk[0], blk[1])
+        dmin = _f16(blk[2], blk[3])
+        scales = blk[4:16]
+        qs = blk[16:]
+        is_ = 0
+        q_off = 0
+        for _ in range(4):  # 64 elements per iteration
+            sc1, m1 = _get_scale_min_k4(is_, scales)
+            sc2, m2 = _get_scale_min_k4(is_ + 1, scales)
+            d1, mm1 = float(d) * sc1, float(dmin) * m1
+            d2, mm2 = float(d) * sc2, float(dmin) * m2
+            for l in range(32):
+                out.append(d1 * (qs[q_off + l] & 0x0F) - mm1)
+            for l in range(32):
+                out.append(d2 * (qs[q_off + l] >> 4) - mm2)
+            q_off += 32
+            is_ += 2
+    return np.array(out, dtype=np.float32)
+
+
+def scalar_dequant_q5_k(raw):
+    out = []
+    for blk in raw.reshape(-1, 176):
+        d = _f16(blk[0], blk[1])
+        dmin = _f16(blk[2], blk[3])
+        scales = blk[4:16]
+        qh = blk[16:48]
+        ql = blk[48:]
+        is_ = 0
+        u1, u2 = 1, 2
+        q_off = 0
+        for _ in range(4):
+            sc1, m1 = _get_scale_min_k4(is_, scales)
+            sc2, m2 = _get_scale_min_k4(is_ + 1, scales)
+            d1, mm1 = float(d) * sc1, float(dmin) * m1
+            d2, mm2 = float(d) * sc2, float(dmin) * m2
+            for l in range(32):
+                out.append(d1 * ((ql[q_off + l] & 0x0F) + (16 if qh[l] & u1 else 0)) - mm1)
+            for l in range(32):
+                out.append(d2 * ((ql[q_off + l] >> 4) + (16 if qh[l] & u2 else 0)) - mm2)
+            q_off += 32
+            is_ += 2
+            u1 <<= 2
+            u2 <<= 2
+    return np.array(out, dtype=np.float32)
+
+
+def scalar_dequant_q6_k(raw):
+    out = []
+    for blk in raw.reshape(-1, 210):
+        ql = blk[0:128].astype(int)
+        qh = blk[128:192].astype(int)
+        sc = blk[192:208].view(np.int8).astype(int)
+        d = _f16(blk[208], blk[209])
+        y = [0.0] * 256
+        for n in range(0, 256, 128):
+            half = n // 128
+            for l in range(32):
+                is_ = l // 16
+                base_ql = 64 * half
+                base_qh = 32 * half
+                base_sc = 8 * half
+                q1 = ((ql[base_ql + l] & 0x0F) | (((qh[base_qh + l] >> 0) & 3) << 4)) - 32
+                q2 = ((ql[base_ql + l + 32] & 0x0F) | (((qh[base_qh + l] >> 2) & 3) << 4)) - 32
+                q3 = ((ql[base_ql + l] >> 4) | (((qh[base_qh + l] >> 4) & 3) << 4)) - 32
+                q4 = ((ql[base_ql + l + 32] >> 4) | (((qh[base_qh + l] >> 6) & 3) << 4)) - 32
+                y[n + l] = float(d) * sc[base_sc + is_] * q1
+                y[n + l + 32] = float(d) * sc[base_sc + is_ + 2] * q2
+                y[n + l + 64] = float(d) * sc[base_sc + is_ + 4] * q3
+                y[n + l + 96] = float(d) * sc[base_sc + is_ + 6] * q4
+        out.extend(y)
+    return np.array(out, dtype=np.float32)
+
+
+def _random_blocks(gtype: GGMLType, nb: int) -> np.ndarray:
+    """Random valid raw blocks: random payload bytes, sane f16 scales."""
+    _, bsize = GGML_BLOCK_SIZES[gtype]
+    raw = rng.integers(0, 256, size=(nb, bsize), dtype=np.uint8)
+    if gtype in (GGMLType.Q8_0, GGMLType.Q4_0):
+        raw[:, 0:2] = _rand_f16_bytes(nb)
+    elif gtype in (GGMLType.Q4_K, GGMLType.Q5_K):
+        raw[:, 0:2] = _rand_f16_bytes(nb)
+        raw[:, 2:4] = _rand_f16_bytes(nb)
+    elif gtype == GGMLType.Q6_K:
+        raw[:, 208:210] = _rand_f16_bytes(nb)
+    return raw.reshape(-1)
+
+
+SCALAR = {
+    GGMLType.Q8_0: scalar_dequant_q8_0,
+    GGMLType.Q4_0: scalar_dequant_q4_0,
+    GGMLType.Q4_K: scalar_dequant_q4_k,
+    GGMLType.Q5_K: scalar_dequant_q5_k,
+    GGMLType.Q6_K: scalar_dequant_q6_k,
+}
+
+
+@pytest.mark.parametrize("gtype", list(SCALAR))
+def test_dequant_matches_scalar_reference(gtype):
+    block_elems, _ = GGML_BLOCK_SIZES[gtype]
+    nb = 7
+    raw = _random_blocks(gtype, nb)
+    fast = quants.dequantize(raw, gtype, nb * block_elems)
+    slow = SCALAR[gtype](raw)
+    np.testing.assert_allclose(fast, slow, rtol=0, atol=0)
+
+
+@pytest.mark.parametrize(
+    "gtype,rel_bound",
+    [
+        (GGMLType.Q8_0, 0.02),
+        (GGMLType.Q4_0, 0.20),
+        (GGMLType.Q4_K, 0.15),
+        (GGMLType.Q5_K, 0.08),
+        (GGMLType.Q6_K, 0.05),
+    ],
+)
+def test_quant_roundtrip_error(gtype, rel_bound):
+    block_elems, _ = GGML_BLOCK_SIZES[gtype]
+    x = rng.standard_normal(block_elems * 16).astype(np.float32)
+    raw = quants.quantize(x, gtype)
+    y = quants.dequantize(raw, gtype, x.size)
+    rms = np.sqrt(np.mean((x - y) ** 2)) / np.sqrt(np.mean(x**2))
+    assert rms < rel_bound, f"{gtype.name} round-trip rms {rms:.4f}"
+
+
+@pytest.mark.parametrize("gtype", [GGMLType.F16, GGMLType.BF16, GGMLType.F32])
+def test_float_formats_roundtrip(gtype):
+    x = rng.standard_normal(256).astype(np.float32)
+    raw = quants.quantize(x, gtype)
+    y = quants.dequantize(raw, gtype, x.size)
+    atol = {GGMLType.F32: 0, GGMLType.F16: 1e-3, GGMLType.BF16: 1e-2}[gtype]
+    np.testing.assert_allclose(x, y, atol=atol, rtol=atol)
+
+
+def test_scale_min_pack_unpack_roundtrip():
+    sc = rng.integers(0, 64, size=(5, 8), dtype=np.uint8)
+    mn = rng.integers(0, 64, size=(5, 8), dtype=np.uint8)
+    packed = quants.pack_scale_min_k4(sc, mn)
+    sc2, mn2 = quants.unpack_scale_min_k4(packed)
+    np.testing.assert_array_equal(sc, sc2)
+    np.testing.assert_array_equal(mn, mn2)
