@@ -1,0 +1,214 @@
+"""Tokenizer unit tests with hand-built vocabularies (SURVEY.md §4 "Unit":
+tokenizer vs known vectors).  Vocabs are synthetic but exercise the real
+algorithms: byte-level BPE merge ranks, SPM score-greedy merging, byte
+fallback, special-token parsing, and GGUF metadata loading."""
+
+import numpy as np
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.gguf import GGUFWriter, GGUFFile
+from llama_fastapi_k8s_gpu_tpu.tokenizer import (
+    BPETokenizer,
+    SPMTokenizer,
+    apply_chat_template,
+    detect_chat_template,
+    tokenizer_from_gguf,
+)
+from llama_fastapi_k8s_gpu_tpu.tokenizer.base import TokenType
+from llama_fastapi_k8s_gpu_tpu.tokenizer.bpe import bytes_to_unicode
+
+
+def make_bpe(extra_tokens=(), merges=(), pre="llama-bpe"):
+    byte_tokens = [bytes_to_unicode()[b] for b in range(256)]
+    merged_tokens = []
+    for m in merges:
+        left, _, right = m.partition(" ")
+        merged_tokens.append(left + right)
+    specials = ["<|begin_of_text|>", "<|start_header_id|>", "<|end_header_id|>",
+                "<|eot_id|>"]
+    tokens = byte_tokens + merged_tokens + list(extra_tokens) + specials
+    types = (
+        [int(TokenType.NORMAL)] * (len(byte_tokens) + len(merged_tokens) + len(extra_tokens))
+        + [int(TokenType.CONTROL)] * len(specials)
+    )
+    bos = tokens.index("<|begin_of_text|>")
+    eot = tokens.index("<|eot_id|>")
+    return BPETokenizer(tokens, list(merges), types, bos_id=bos, eos_id=eot, pre=pre)
+
+
+MERGES = ["h e", "l l", "he ll", "hell o", "Ġ hello"]
+
+
+def test_bpe_merge_order():
+    tok = make_bpe(merges=MERGES)
+    ids = tok.encode("hello hello", add_bos=False)
+    assert [tok.id_to_piece(i) for i in ids] == ["hello", "Ġhello"]
+
+
+def test_bpe_roundtrip_unicode():
+    tok = make_bpe(merges=MERGES)
+    rng = np.random.default_rng(3)
+    samples = [
+        "hello world",
+        "héllo wörld — ‘quotes’ & €",
+        "日本語のテキスト",
+        "tabs\tand\nnewlines\r\n  spaces",
+        "emoji 🤖🔥",
+        "".join(chr(int(c)) for c in rng.integers(32, 0x2FFF, size=64)),
+    ]
+    for s in samples:
+        ids = tok.encode(s, add_bos=False)
+        assert tok.decode(ids) == s, repr(s)
+
+
+def test_bpe_llama3_pretokenizer_splits():
+    tok = make_bpe(merges=MERGES)
+    # digits grouped ≤3; contractions split; punctuation grabs leading space
+    assert tok._pattern.findall("12345") == ["123", "45"]
+    assert tok._pattern.findall("I'm fine") == ["I", "'m", " fine"]
+    assert tok._pattern.findall("a ,b") == ["a", " ,", "b"]
+
+
+def test_bpe_special_token_parsing():
+    tok = make_bpe(merges=MERGES)
+    text = "hello<|eot_id|>"
+    with_special = tok.encode(text, add_bos=False, parse_special=True)
+    assert with_special[-1] == tok.token_to_id["<|eot_id|>"]
+    without = tok.encode(text, add_bos=False, parse_special=False)
+    # literal "<|eot_id|>" chars, not the control id
+    assert tok.token_to_id["<|eot_id|>"] not in without
+    assert tok.decode(without) == text
+    # control tokens skipped on decode by default, kept when asked
+    assert tok.decode(with_special) == "hello"
+    assert tok.decode(with_special, skip_special=False) == text
+
+
+def test_bpe_add_bos():
+    tok = make_bpe(merges=MERGES)
+    ids = tok.encode("hello")  # add_bos defaults True
+    assert ids[0] == tok.bos_id
+
+
+SPM_TOKENS = [
+    ("<unk>", TokenType.UNKNOWN, 0.0),
+    ("<s>", TokenType.CONTROL, 0.0),
+    ("</s>", TokenType.CONTROL, 0.0),
+    ("▁", TokenType.NORMAL, -1.0),
+    ("▁h", TokenType.NORMAL, 1.0),
+    ("▁he", TokenType.NORMAL, 2.0),
+    ("ll", TokenType.NORMAL, 1.5),
+    ("lo", TokenType.NORMAL, 0.5),
+    ("llo", TokenType.NORMAL, 3.0),
+    ("▁hello", TokenType.NORMAL, 5.0),
+    ("h", TokenType.NORMAL, -2.0),
+    ("e", TokenType.NORMAL, -2.0),
+    ("l", TokenType.NORMAL, -2.0),
+    ("o", TokenType.NORMAL, -2.0),
+    ("<0xE2>", TokenType.BYTE, 0.0),
+    ("<0x82>", TokenType.BYTE, 0.0),
+    ("<0xAC>", TokenType.BYTE, 0.0),
+]
+
+
+def make_spm():
+    tokens = [t for t, _, _ in SPM_TOKENS]
+    types = [int(ty) for _, ty, _ in SPM_TOKENS]
+    scores = [s for _, _, s in SPM_TOKENS]
+    return SPMTokenizer(tokens, scores, types, bos_id=1, eos_id=2)
+
+
+def test_spm_score_greedy_merge():
+    tok = make_spm()
+    ids = tok.encode("hello", add_bos=False)
+    assert [tok.id_to_piece(i) for i in ids] == ["▁hello"]
+    assert tok.decode(ids) == "hello"
+
+
+def test_spm_partial_merge_and_decode():
+    tok = make_spm()
+    ids = tok.encode("he llo", add_bos=False)
+    pieces = [tok.id_to_piece(i) for i in ids]
+    assert pieces == ["▁he", "▁", "llo"]
+    assert tok.decode(ids) == "he llo"
+
+
+def test_spm_byte_fallback():
+    tok = make_spm()
+    ids = tok.encode("€", add_bos=False)  # only via <0xE2><0x82><0xAC>
+    pieces = [tok.id_to_piece(i) for i in ids]
+    assert pieces[-3:] == ["<0xE2>", "<0x82>", "<0xAC>"]
+    assert tok.decode(ids) == "€"
+
+
+def test_spm_bos_and_controls():
+    tok = make_spm()
+    ids = tok.encode("hello")
+    assert ids[0] == 1
+    assert tok.decode(ids) == "hello"
+
+
+def test_chat_template_detection():
+    bpe = make_bpe(merges=MERGES)
+    spm = make_spm()
+    assert detect_chat_template("{{...<|start_header_id|>...}}", spm) == "llama3"
+    assert detect_chat_template("{% [INST] %}", bpe) == "mistral"
+    assert detect_chat_template(None, bpe) == "llama3"  # vocab fingerprint
+    assert detect_chat_template(None, spm) == "mistral"
+
+
+def test_llama3_chat_template_structure():
+    tok = make_bpe(merges=MERGES)
+    msgs = [
+        {"role": "system", "content": "be nice"},
+        {"role": "user", "content": "hello"},
+    ]
+    ids = apply_chat_template(tok, msgs, kind="llama3")
+    sh = tok.token_to_id["<|start_header_id|>"]
+    eh = tok.token_to_id["<|end_header_id|>"]
+    eot = tok.token_to_id["<|eot_id|>"]
+    assert ids[0] == tok.bos_id
+    assert ids.count(sh) == 3  # system, user, assistant header
+    assert ids.count(eot) == 2
+    # ends with assistant header then "\n\n" (no trailing eot)
+    assert ids[-1] != eot
+    text = tok.decode(ids, skip_special=False)
+    assert text.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+    assert "<|start_header_id|>user<|end_header_id|>\n\nhello<|eot_id|>" in text
+
+
+def test_mistral_chat_template_structure():
+    tok = make_spm()
+    msgs = [
+        {"role": "system", "content": "sys"},
+        {"role": "user", "content": "hello"},
+        {"role": "assistant", "content": "hey"},
+        {"role": "user", "content": "again"},
+    ]
+    from llama_fastapi_k8s_gpu_tpu.tokenizer.chat_template import render_mistral
+    text = render_mistral(msgs)
+    assert text == "[INST] sys\n\nhello [/INST] hey</s>[INST] again [/INST]"
+
+
+def test_tokenizer_from_gguf_roundtrip(tmp_path):
+    p = str(tmp_path / "tok.gguf")
+    w = GGUFWriter(p)
+    w.add_metadata("general.architecture", "llama")
+    byte_tokens = [bytes_to_unicode()[b] for b in range(256)]
+    merged = ["he", "ll", "hell", "hello", "Ġhello"]
+    specials = ["<|begin_of_text|>", "<|eot_id|>"]
+    tokens = byte_tokens + merged + specials
+    types = [1] * (len(byte_tokens) + len(merged)) + [3] * 2
+    w.add_metadata("tokenizer.ggml.model", "gpt2")
+    w.add_metadata("tokenizer.ggml.tokens", tokens)
+    w.add_metadata("tokenizer.ggml.token_type", types)
+    w.add_metadata("tokenizer.ggml.merges", MERGES)
+    w.add_metadata("tokenizer.ggml.bos_token_id", tokens.index("<|begin_of_text|>"))
+    w.add_metadata("tokenizer.ggml.eos_token_id", tokens.index("<|eot_id|>"))
+    w.add_metadata("tokenizer.ggml.pre", "llama-bpe")
+    w.write()
+
+    tok = tokenizer_from_gguf(GGUFFile(p))
+    ids = tok.encode("hello hello", add_bos=False)
+    assert [tok.id_to_piece(i) for i in ids] == ["hello", "Ġhello"]
+    assert tok.decode(ids) == "hello hello"
+    assert tok.stop_ids == {tok.token_to_id["<|eot_id|>"]}
