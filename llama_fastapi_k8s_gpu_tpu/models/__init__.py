@@ -1,0 +1,3 @@
+from .config import ModelConfig  # noqa: F401
+from .llama import init_cache, prefill, decode_step, forward  # noqa: F401
+from .params import load_params, synth_params  # noqa: F401

@@ -1,0 +1,83 @@
+"""On-device autoregressive generation.
+
+The hot loop the reference runs inside llama.cpp's C++ decode (SURVEY.md §3.2
+"THE hot loop") becomes a ``lax.scan`` over decode steps: embed → layers →
+logits → sampling chain → next token, entirely on device.  The host only sees
+a chunk of ``n_steps`` sampled tokens per dispatch (checks stop conditions,
+streams text out), so per-token host↔device round-trips — the classic TPU
+decode-latency killer — are amortized away.  The KV cache and generation
+state are donated across chunks, so decode is allocation-free at steady
+state.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..sampling.sample import PENALTY_WINDOW, sample_chain
+from .config import ModelConfig
+from .llama import forward, init_cache, prefill
+
+
+def init_state(cfg: ModelConfig, cache=None, seed: int = 0) -> dict:
+    """Generation state pytree (cache + position + sampling state)."""
+    return {
+        "cache": cache if cache is not None else init_cache(cfg),
+        "pos": jnp.int32(0),                # next cache slot to write
+        "token": jnp.int32(0),              # token to feed next
+        "window": jnp.full(PENALTY_WINDOW, -1, jnp.int32),
+        "wpos": jnp.int32(0),
+        "key": jax.random.PRNGKey(seed),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill_jit(params, cfg: ModelConfig, tokens, length, cache):
+    """Bucketed prompt pass. tokens (S,) padded; length = real count.
+    Returns (logits_at_last_real_token, cache)."""
+    return prefill(params, cfg, tokens, length, cache)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k"))
+def sample_jit(logits, window, wpos, key, st, cfg: ModelConfig, top_k: int = 40):
+    """Sample the first token (from prefill logits) and update sampler state."""
+    key, sub = jax.random.split(key)
+    token = sample_chain(logits, window, sub, st, top_k=top_k)
+    window = window.at[wpos % PENALTY_WINDOW].set(token)
+    return token, window, wpos + 1, key
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "top_k"),
+    donate_argnames=("state",),
+)
+def generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
+                       n_steps: int, top_k: int = 40):
+    """Run ``n_steps`` decode+sample steps on device.
+
+    state["token"] is the most recently sampled (not yet decoded) token.
+    Returns (new_state, tokens (n_steps,)) — the tokens sampled this chunk.
+    """
+
+    def step(carry, _):
+        logits, cache = forward(
+            params, cfg, carry["token"][None], carry["pos"], carry["cache"]
+        )
+        key, sub = jax.random.split(carry["key"])
+        token = sample_chain(logits, carry["window"], sub, st, top_k=top_k)
+        window = carry["window"].at[carry["wpos"] % PENALTY_WINDOW].set(token)
+        new_carry = {
+            "cache": cache,
+            "pos": carry["pos"] + 1,
+            "token": token,
+            "window": window,
+            "wpos": carry["wpos"] + 1,
+            "key": key,
+        }
+        return new_carry, token
+
+    return jax.lax.scan(step, state, None, length=n_steps)

@@ -1,0 +1,142 @@
+"""Llama-family transformer as pure JAX functions.
+
+This is the in-tree replacement for the model graph the reference runs inside
+llama.cpp (``create_chat_completion``'s prefill/decode, reference
+api.py:55-63): RMSNorm → GQA attention with interleaved RoPE → SwiGLU, over a
+preallocated, donated KV cache.  Design choices are TPU-first:
+
+- layers are *stacked* and iterated with ``lax.scan`` so XLA compiles one
+  layer body regardless of depth (compile time ∝ 1, not n_layers);
+- K/V are written with ``dynamic_update_slice`` and attention masks the full
+  ``n_ctx`` ring, so prefill and decode share one code path with static
+  shapes (prompt lengths are bucketed by the engine to bound recompiles);
+- sliding-window masking (Mistral) is the same mask with one extra term;
+- matmuls go through ``ops.linear`` so bf16 / int8 / (later) fused-Q4_K
+  weights are interchangeable without touching the graph.
+
+RoPE is the *interleaved* (ggml "NORM") variant: GGUF conversion permutes
+Q/K weights to this convention, so parity with llama.cpp requires it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import linear
+from .config import ModelConfig
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return ((x32 * inv) * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_interleaved(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: (S, H, hd); rotate pairs (2i, 2i+1) by pos * theta^(-2i/hd)."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # (S, half)
+    cos = jnp.cos(ang)[:, None, :]  # (S, 1, half)
+    sin = jnp.sin(ang)[:, None, :]
+    xf = x.astype(jnp.float32)
+    x1 = xf[..., 0::2]
+    x2 = xf[..., 1::2]
+    o1 = x1 * cos - x2 * sin
+    o2 = x1 * sin + x2 * cos
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def init_cache(cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
+    shape = (cfg.n_layers, cfg.n_ctx, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _layer(h, lp, ck, cv, positions, pos_offset, cfg: ModelConfig):
+    """One transformer block over S tokens. ck/cv: (n_ctx, n_kv, hd)."""
+    S = h.shape[0]
+    n_kv, group, hd = cfg.n_kv_heads, cfg.n_heads // cfg.n_kv_heads, cfg.head_dim
+
+    hn = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
+    q = linear(hn, lp["wq"]).reshape(S, cfg.n_heads, hd)
+    k = linear(hn, lp["wk"]).reshape(S, n_kv, hd)
+    v = linear(hn, lp["wv"]).reshape(S, n_kv, hd)
+    q = rope_interleaved(q, positions, cfg.rope_theta)
+    k = rope_interleaved(k, positions, cfg.rope_theta)
+
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (pos_offset, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (pos_offset, 0, 0))
+
+    # (S, n_kv, group, hd) → (n_kv, group, S, hd)
+    qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
+    kk = ck.transpose(1, 0, 2)  # (n_kv, n_ctx, hd)
+    vv = cv.transpose(1, 0, 2)
+    scores = jnp.einsum(
+        "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)  # (n_kv, group, S, n_ctx)
+
+    key_pos = jnp.arange(cfg.n_ctx)
+    q_pos = positions  # (S,)
+    mask = key_pos[None, :] <= q_pos[:, None]  # causal over the whole ring
+    if cfg.sliding_window:
+        mask &= key_pos[None, :] > q_pos[:, None] - cfg.sliding_window
+    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
+    ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv)  # (n_kv, group, S, hd)
+    ctx = ctx.transpose(2, 0, 1, 3).reshape(S, cfg.n_heads * hd).astype(h.dtype)
+    h = h + linear(ctx, lp["wo"])
+
+    hn = rms_norm(h, lp["ffn_norm"], cfg.rms_eps)
+    gated = jax.nn.silu(linear(hn, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    h = h + linear(gated * linear(hn, lp["w_up"]), lp["w_down"])
+    return h, ck, cv
+
+
+def forward(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,      # (S,) int32, padded to a static bucket
+    pos_offset: jax.Array,  # scalar int32: cache position of tokens[0]
+    cache: dict,
+    last_idx: jax.Array | None = None,  # scalar int32: position of last real token
+    return_all: bool = False,
+):
+    """Run S tokens through the stack. Returns (logits, new_cache):
+    logits (vocab,) at ``last_idx`` (default S-1), or (S, vocab) if
+    ``return_all``."""
+    S = tokens.shape[0]
+    h = jnp.take(params["tok_emb"], tokens, axis=0).astype(jnp.bfloat16)
+    positions = pos_offset + jnp.arange(S, dtype=jnp.int32)
+
+    def step(carry, xs):
+        lp, ck, cv = xs
+        hh, ck, cv = _layer(carry, lp, ck, cv, positions, pos_offset, cfg)
+        return hh, (ck, cv)
+
+    h, (new_k, new_v) = jax.lax.scan(step, h, (params["layers"], cache["k"], cache["v"]))
+    new_cache = {"k": new_k, "v": new_v}
+
+    out_w = params["output"]
+    if return_all:
+        hn = rms_norm(h, params["out_norm"], cfg.rms_eps)
+        logits = linear(hn, out_w).astype(jnp.float32)
+        return logits, new_cache
+    if last_idx is None:
+        last_idx = jnp.int32(S - 1)
+    h_last = jax.lax.dynamic_slice_in_dim(h, last_idx, 1, axis=0)
+    hn = rms_norm(h_last, params["out_norm"], cfg.rms_eps)
+    logits = linear(hn, out_w).astype(jnp.float32)[0]
+    return logits, new_cache
+
+
+def prefill(params, cfg: ModelConfig, tokens, length, cache):
+    """Prompt pass: tokens padded to a bucket, ``length`` = real token count.
+    Returns logits at the last real token."""
+    return forward(params, cfg, tokens, jnp.int32(0), cache, last_idx=length - 1)
+
+
+def decode_step(params, cfg: ModelConfig, token, pos, cache):
+    """One autoregressive step: ``token`` at cache position ``pos``."""
+    return forward(params, cfg, token[None], pos, cache)

@@ -1,0 +1,127 @@
+"""Parameter loading: GGUF tensors → stacked JAX pytrees.
+
+Performs at load time what llama.cpp does lazily per-matmul on GPU: weights
+are dequantized once (numpy reference codecs; Pallas dequant kernels take
+over on TPU) and placed in HBM in the chosen compute format.  Stacking the
+per-layer tensors (axis 0 = layer) is what lets the model scan over layers.
+
+Formats (``ops.linear``):
+- ``bf16`` — exact dequant, 2 B/weight.  16 GB for Llama-3-8B: does NOT fit
+  one v5e chip; use for small models and CPU tests.
+- ``int8`` — symmetric per-channel requant of the dequantized weights,
+  1 B/weight (~8.5 GB for 8B incl. bf16 embeddings): the v5e serving format
+  until the fused-Q4_K Pallas path lands.
+
+GGUF tensor names follow llama.cpp's convention: ``token_embd.weight``,
+``blk.{i}.attn_{q,k,v,output}.weight``, ``blk.{i}.ffn_{gate,up,down}.weight``,
+``blk.{i}.{attn,ffn}_norm.weight``, ``output_norm.weight``, ``output.weight``
+(absent when embeddings are tied).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..gguf import GGUFFile
+from ..ops import make_linear_bf16, make_linear_int8
+from .config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+_LINEAR_MAKERS = {"bf16": make_linear_bf16, "int8": make_linear_int8}
+
+
+def _stack(dicts: list[dict]) -> dict:
+    """List of identically-keyed (possibly nested) dicts → dict of stacked arrays."""
+    out = {}
+    for key in dicts[0]:
+        vals = [d[key] for d in dicts]
+        if isinstance(vals[0], dict):
+            out[key] = _stack(vals)
+        else:
+            out[key] = jnp.stack(vals)
+    return out
+
+
+def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16") -> dict:
+    """Dequantize all tensors from ``gf`` into a stacked param pytree."""
+    make = _LINEAR_MAKERS[fmt]
+
+    def lin(name: str) -> dict:
+        return make(gf[name].astype_f32())
+
+    def norm(name: str):
+        return jnp.asarray(gf[name].astype_f32(), dtype=jnp.float32)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        p = f"blk.{i}."
+        layers.append({
+            "attn_norm": norm(p + "attn_norm.weight"),
+            "wq": lin(p + "attn_q.weight"),
+            "wk": lin(p + "attn_k.weight"),
+            "wv": lin(p + "attn_v.weight"),
+            "wo": lin(p + "attn_output.weight"),
+            "ffn_norm": norm(p + "ffn_norm.weight"),
+            "w_gate": lin(p + "ffn_gate.weight"),
+            "w_up": lin(p + "ffn_up.weight"),
+            "w_down": lin(p + "ffn_down.weight"),
+        })
+        logger.debug("loaded layer %d/%d", i + 1, cfg.n_layers)
+
+    emb = jnp.asarray(gf["token_embd.weight"].astype_f32(), dtype=jnp.bfloat16)
+    if cfg.tie_embeddings or "output.weight" not in gf.tensors:
+        output = {"w": emb}
+    else:
+        output = make(gf["output.weight"].astype_f32())
+    return {
+        "tok_emb": emb,
+        "layers": _stack(layers),
+        "out_norm": norm("output_norm.weight"),
+        "output": output,
+    }
+
+
+def synth_params(cfg: ModelConfig, fmt: str = "bf16", seed: int = 0,
+                 scale: float | None = None) -> dict:
+    """Random-weight params with the exact structure of :func:`load_params`.
+
+    Used for tests and for benchmarking real-size models without network
+    egress (BASELINE.md: bench models are synthesized, not downloaded).
+    """
+    rng = np.random.default_rng(seed)
+    make = _LINEAR_MAKERS[fmt]
+    if scale is None:
+        scale = cfg.dim ** -0.5
+
+    def lin(out_dim, in_dim):
+        return make(rng.standard_normal((out_dim, in_dim), dtype=np.float32) * scale)
+
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "attn_norm": jnp.ones(cfg.dim, jnp.float32),
+            "wq": lin(cfg.dim, cfg.dim),
+            "wk": lin(kv_dim, cfg.dim),
+            "wv": lin(kv_dim, cfg.dim),
+            "wo": lin(cfg.dim, cfg.dim),
+            "ffn_norm": jnp.ones(cfg.dim, jnp.float32),
+            "w_gate": lin(cfg.ffn_dim, cfg.dim),
+            "w_up": lin(cfg.ffn_dim, cfg.dim),
+            "w_down": lin(cfg.dim, cfg.ffn_dim),
+        })
+    emb = jnp.asarray(
+        rng.standard_normal((cfg.vocab_size, cfg.dim), dtype=np.float32) * scale,
+        dtype=jnp.bfloat16,
+    )
+    output = {"w": emb} if cfg.tie_embeddings else lin(cfg.vocab_size, cfg.dim)
+    return {
+        "tok_emb": emb,
+        "layers": _stack(layers),
+        "out_norm": jnp.ones(cfg.dim, jnp.float32),
+        "output": output,
+    }

@@ -1,0 +1,1 @@
+from .linear import linear, make_linear_bf16, make_linear_int8  # noqa: F401

@@ -1,0 +1,1 @@
+from .sample import SamplingParams, sample_chain, sampling_tensors  # noqa: F401

@@ -1,0 +1,109 @@
+"""llama.cpp-parity sampling chain, fully on-device.
+
+The reference calls ``create_chat_completion(temperature=1.2, top_p=0.9,
+frequency_penalty=0.7, presence_penalty=0.8)`` (reference api.py:59-62) and
+inherits llama-cpp-python 0.2.77 defaults for everything it omits:
+``top_k=40``, ``min_p=0.05``, ``repeat_penalty=1.1`` over the last 64 tokens.
+Behavior parity therefore requires the full chain, in llama.cpp's order:
+
+1. repetition + frequency/presence penalties over a 64-token ring buffer
+   (prompt tail included, as llama.cpp seeds last_tokens with the prompt);
+2. top-k (k=40, static → cheap ``lax.top_k`` instead of a 128k-vocab sort);
+3. softmax over the k candidates, top-p on those *untempered* probabilities
+   (llama.cpp applies temperature after top-p/min-p);
+4. min-p relative to the max candidate probability;
+5. temperature, then categorical draw — or argmax when temperature ≤ 0.
+
+Everything is jit-compatible; per-request knobs are traced scalars so
+changing them never recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+PENALTY_WINDOW = 64  # llama.cpp repeat_last_n default
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 1.2
+    top_p: float = 0.9
+    top_k: int = 40                  # static: participates in compiled shape
+    min_p: float = 0.05
+    frequency_penalty: float = 0.7
+    presence_penalty: float = 0.8
+    repeat_penalty: float = 1.1
+
+
+def sampling_tensors(sp: SamplingParams) -> dict:
+    """The traced (non-shape-affecting) knobs as a pytree of f32 scalars."""
+    return {
+        "temperature": jnp.float32(sp.temperature),
+        "top_p": jnp.float32(sp.top_p),
+        "min_p": jnp.float32(sp.min_p),
+        "frequency_penalty": jnp.float32(sp.frequency_penalty),
+        "presence_penalty": jnp.float32(sp.presence_penalty),
+        "repeat_penalty": jnp.float32(sp.repeat_penalty),
+    }
+
+
+def apply_penalties(logits: jax.Array, window: jax.Array, st: dict) -> jax.Array:
+    """window: (PENALTY_WINDOW,) int32, -1 = empty slot."""
+    vocab = logits.shape[0]
+    valid = window >= 0
+    idx = jnp.clip(window, 0, vocab - 1)
+    counts = jnp.zeros(vocab, jnp.float32).at[idx].add(valid.astype(jnp.float32))
+    present = counts > 0
+    rp = st["repeat_penalty"]
+    logits = jnp.where(
+        present,
+        jnp.where(logits > 0, logits / rp, logits * rp),
+        logits,
+    )
+    logits = logits - counts * st["frequency_penalty"] - present * st["presence_penalty"]
+    return logits
+
+
+def sample_chain(
+    logits: jax.Array,   # (vocab,) f32
+    window: jax.Array,   # (PENALTY_WINDOW,) int32 ring buffer, -1 empty
+    key: jax.Array,
+    st: dict,            # sampling_tensors()
+    top_k: int = 40,
+) -> jax.Array:
+    logits = apply_penalties(logits.astype(jnp.float32), window, st)
+    vals, idx = jax.lax.top_k(logits, top_k)          # sorted desc
+    probs = jax.nn.softmax(vals)                      # untempered, over candidates
+    cum_excl = jnp.cumsum(probs) - probs
+    keep = cum_excl < st["top_p"]                     # keeps the crossing token
+    keep &= probs >= st["min_p"] * probs[0]
+    keep = keep.at[0].set(True)                       # min_keep = 1
+    masked = jnp.where(keep, vals, -jnp.inf)
+    temp = st["temperature"]
+    sampled = jax.random.categorical(key, masked / jnp.maximum(temp, 1e-6))
+    choice = jnp.where(temp <= 0, 0, sampled)         # temp<=0 → greedy (idx[0])
+    return idx[choice]
+
+
+def update_window(window: jax.Array, wpos: jax.Array, token: jax.Array):
+    """Push token into the ring buffer; returns (window, wpos+1)."""
+    window = window.at[wpos % PENALTY_WINDOW].set(token)
+    return window, wpos + 1
+
+
+def seed_window(prompt_ids, vocab_pad_id: int = -1):
+    """Ring buffer seeded with the prompt tail, as llama.cpp seeds last_tokens."""
+    import numpy as np
+
+    window = np.full(PENALTY_WINDOW, -1, dtype=np.int32)
+    tail = list(prompt_ids)[-PENALTY_WINDOW:]
+    wpos = len(tail) % PENALTY_WINDOW
+    for j, t in enumerate(tail):
+        window[j % PENALTY_WINDOW] = t
+    if len(tail) == PENALTY_WINDOW:
+        wpos = 0
+    return jnp.asarray(window), jnp.int32(wpos)

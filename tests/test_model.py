@@ -1,0 +1,136 @@
+"""Model-core tests on the XLA-CPU backend (SURVEY.md §4 "Device tests").
+
+The load-bearing check is teacher-forcing consistency: a full-sequence
+forward (return_all) must match incremental prefill+decode through the KV
+cache at every position — this pins RoPE positions, cache indexing, masking,
+and GQA head grouping all at once.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.models import ModelConfig, init_cache, prefill, forward
+from llama_fastapi_k8s_gpu_tpu.models.llama import decode_step
+from llama_fastapi_k8s_gpu_tpu.models.params import synth_params
+from llama_fastapi_k8s_gpu_tpu.ops import linear, make_linear_int8, make_linear_bf16
+
+CFG = ModelConfig(
+    vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=64, n_ctx=32, rope_theta=10000.0,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(CFG, fmt="bf16", seed=0)
+
+
+def test_full_vs_incremental_consistency(params):
+    rng = np.random.default_rng(0)
+    T = 10
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, T), dtype=jnp.int32)
+
+    # full pass, all logits
+    full_logits, _ = forward(
+        params, CFG, tokens, jnp.int32(0), init_cache(CFG), return_all=True
+    )
+
+    # incremental: prefill 4, then decode the rest one at a time
+    P = 4
+    cache = init_cache(CFG)
+    logits_p, cache = prefill(params, CFG, tokens[:P], jnp.int32(P), cache)
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(full_logits[P - 1]), rtol=0.05, atol=0.05
+    )
+    for t in range(P, T):
+        logits_t, cache = decode_step(params, CFG, tokens[t], jnp.int32(t), cache)
+        np.testing.assert_allclose(
+            np.asarray(logits_t), np.asarray(full_logits[t]), rtol=0.05, atol=0.05,
+            err_msg=f"position {t}",
+        )
+
+
+def test_padded_prefill_matches_exact(params):
+    rng = np.random.default_rng(1)
+    T = 5
+    tokens = rng.integers(0, CFG.vocab_size, T)
+    exact = jnp.asarray(tokens, dtype=jnp.int32)
+    padded = jnp.asarray(list(tokens) + [0] * 11, dtype=jnp.int32)  # bucket 16
+
+    l1, _ = prefill(params, CFG, exact, jnp.int32(T), init_cache(CFG))
+    l2, _ = prefill(params, CFG, padded, jnp.int32(T), init_cache(CFG))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=0.05, atol=0.05)
+
+
+def test_decode_after_padded_prefill_ignores_pad(params):
+    """Pad slots beyond the prompt must not leak into later decode steps."""
+    rng = np.random.default_rng(2)
+    T = 5
+    tokens = rng.integers(0, CFG.vocab_size, T)
+    nxt = int(rng.integers(0, CFG.vocab_size))
+
+    cache = init_cache(CFG)
+    _, cache = prefill(params, CFG, jnp.asarray(tokens, jnp.int32), jnp.int32(T), cache)
+    la, _ = decode_step(params, CFG, jnp.int32(nxt), jnp.int32(T), cache)
+
+    padded = jnp.asarray(list(tokens) + [7] * 11, dtype=jnp.int32)
+    cache2 = init_cache(CFG)
+    _, cache2 = prefill(params, CFG, padded, jnp.int32(T), cache2)
+    lb, _ = decode_step(params, CFG, jnp.int32(nxt), jnp.int32(T), cache2)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=0.05, atol=0.05)
+
+
+def test_sliding_window_masks_old_tokens(params):
+    rng = np.random.default_rng(3)
+    T = 12
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, T), dtype=jnp.int32)
+
+    cfg_full = CFG
+    cfg_big_win = ModelConfig(**{**CFG.__dict__, "sliding_window": 64})
+    cfg_small_win = ModelConfig(**{**CFG.__dict__, "sliding_window": 4})
+
+    lf, _ = forward(params, cfg_full, tokens, jnp.int32(0), init_cache(cfg_full), return_all=True)
+    lb, _ = forward(params, cfg_big_win, tokens, jnp.int32(0), init_cache(cfg_big_win), return_all=True)
+    ls, _ = forward(params, cfg_small_win, tokens, jnp.int32(0), init_cache(cfg_small_win), return_all=True)
+
+    # window ≥ seq behaves exactly like full attention
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(lb), rtol=1e-5, atol=1e-5)
+    # a small window must change late-position logits
+    assert not np.allclose(np.asarray(lf[-1]), np.asarray(ls[-1]), rtol=0.05, atol=0.05)
+    # ...but not the first position (window covers it)
+    np.testing.assert_allclose(np.asarray(lf[0]), np.asarray(ls[0]), rtol=1e-5, atol=1e-5)
+
+
+def test_int8_linear_close_to_bf16():
+    rng = np.random.default_rng(4)
+    w = rng.standard_normal((64, 32)).astype(np.float32) * 0.1
+    x = jnp.asarray(rng.standard_normal((5, 32)), dtype=jnp.bfloat16)
+    y_ref = np.asarray(linear(x, make_linear_bf16(w)), dtype=np.float32)
+    y_q = np.asarray(linear(x, make_linear_int8(w)), dtype=np.float32)
+    rel = np.abs(y_q - y_ref).max() / (np.abs(y_ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_int8_model_close_to_bf16_model():
+    p16 = synth_params(CFG, fmt="bf16", seed=0)
+    p8 = synth_params(CFG, fmt="int8", seed=0)
+    tokens = jnp.arange(6, dtype=jnp.int32)
+    l16, _ = forward(p16, CFG, tokens, jnp.int32(0), init_cache(CFG), return_all=True)
+    l8, _ = forward(p8, CFG, tokens, jnp.int32(0), init_cache(CFG), return_all=True)
+    # logits drift but top-1 should rarely flip on a random tiny model;
+    # require high overlap rather than exact match
+    top16 = np.asarray(jnp.argmax(l16, -1))
+    top8 = np.asarray(jnp.argmax(l8, -1))
+    assert (top16 == top8).mean() >= 0.5
+
+
+def test_tied_embeddings():
+    cfg = ModelConfig(**{**CFG.__dict__, "tie_embeddings": True})
+    p = synth_params(cfg, fmt="bf16", seed=5)
+    assert p["output"]["w"] is p["tok_emb"]
+    logits, _ = forward(p, cfg, jnp.arange(4, dtype=jnp.int32), jnp.int32(0),
+                        init_cache(cfg))
+    assert logits.shape == (cfg.vocab_size,)
+    assert np.isfinite(np.asarray(logits)).all()

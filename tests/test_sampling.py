@@ -1,0 +1,121 @@
+"""Sampling-chain tests (SURVEY.md §4: "sampling (top-p mass, penalty
+arithmetic) with fixed RNG keys")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llama_fastapi_k8s_gpu_tpu.sampling import SamplingParams, sample_chain, sampling_tensors
+from llama_fastapi_k8s_gpu_tpu.sampling.sample import (
+    PENALTY_WINDOW,
+    apply_penalties,
+    seed_window,
+    update_window,
+)
+
+V = 100
+
+
+def st_of(**kw):
+    return sampling_tensors(SamplingParams(**kw))
+
+
+def empty_window():
+    return jnp.full(PENALTY_WINDOW, -1, jnp.int32)
+
+
+def test_greedy_when_temperature_zero():
+    logits = jnp.asarray(np.random.default_rng(0).standard_normal(V), jnp.float32)
+    st = st_of(temperature=0.0)
+    for seed in range(5):
+        tok = sample_chain(logits, empty_window(), jax.random.PRNGKey(seed), st)
+        assert int(tok) == int(jnp.argmax(logits))
+
+
+def test_tiny_top_p_is_argmax():
+    logits = jnp.asarray(np.random.default_rng(1).standard_normal(V), jnp.float32)
+    st = st_of(temperature=5.0, top_p=1e-9, min_p=0.0,
+               frequency_penalty=0.0, presence_penalty=0.0, repeat_penalty=1.0)
+    for seed in range(10):
+        tok = sample_chain(logits, empty_window(), jax.random.PRNGKey(seed), st)
+        assert int(tok) == int(jnp.argmax(logits))
+
+
+def test_top_k_restricts_support():
+    logits = jnp.asarray(np.arange(V, dtype=np.float32))  # ids 90..99 are top-10
+    st = st_of(temperature=10.0, top_p=1.0, min_p=0.0,
+               frequency_penalty=0.0, presence_penalty=0.0, repeat_penalty=1.0)
+    seen = set()
+    for seed in range(200):
+        tok = sample_chain(logits, empty_window(), jax.random.PRNGKey(seed), st, top_k=10)
+        seen.add(int(tok))
+    assert seen <= set(range(90, 100))
+    assert len(seen) > 3  # high temp: spread over several candidates
+
+
+def test_top_p_mass():
+    # one dominant token (p≈0.9) + uniform tail; top_p=0.5 → only the dominant
+    logits = np.zeros(V, np.float32)
+    logits[42] = 10.0
+    st = st_of(temperature=1.0, top_p=0.5, min_p=0.0,
+               frequency_penalty=0.0, presence_penalty=0.0, repeat_penalty=1.0)
+    for seed in range(20):
+        tok = sample_chain(jnp.asarray(logits), empty_window(),
+                           jax.random.PRNGKey(seed), st)
+        assert int(tok) == 42
+
+
+def test_min_p_filters_tail():
+    logits = np.zeros(V, np.float32)
+    logits[7] = 5.0
+    logits[8] = 4.9
+    # tail has p < min_p * p_max → only 7 and 8 survive
+    st = st_of(temperature=3.0, top_p=1.0, min_p=0.5,
+               frequency_penalty=0.0, presence_penalty=0.0, repeat_penalty=1.0)
+    seen = set()
+    for seed in range(100):
+        tok = sample_chain(jnp.asarray(logits), empty_window(),
+                           jax.random.PRNGKey(seed), st)
+        seen.add(int(tok))
+    assert seen <= {7, 8}
+
+
+def test_penalty_arithmetic():
+    logits = jnp.zeros(V, jnp.float32).at[3].set(2.0).at[5].set(-1.0)
+    window = empty_window().at[0].set(3).at[1].set(3).at[2].set(5)
+    st = st_of(frequency_penalty=0.7, presence_penalty=0.8, repeat_penalty=1.1)
+    out = np.asarray(apply_penalties(logits, window, st))
+    # token 3: positive → /1.1, then -2*0.7 -0.8 (count=2)
+    np.testing.assert_allclose(out[3], 2.0 / 1.1 - 1.4 - 0.8, rtol=1e-6)
+    # token 5: negative → *1.1, count=1
+    np.testing.assert_allclose(out[5], -1.0 * 1.1 - 0.7 - 0.8, rtol=1e-6)
+    # untouched token unchanged
+    np.testing.assert_allclose(out[10], 0.0, atol=1e-7)
+
+
+def test_penalty_flips_argmax():
+    logits = jnp.zeros(V, jnp.float32).at[3].set(1.0).at[4].set(0.9)
+    window = empty_window().at[0].set(3)
+    st = st_of(temperature=0.0)
+    tok = sample_chain(logits, window, jax.random.PRNGKey(0), st)
+    assert int(tok) == 4  # 3 was penalized below 4
+
+
+def test_same_key_same_token():
+    logits = jnp.asarray(np.random.default_rng(2).standard_normal(V), jnp.float32)
+    st = st_of()
+    a = sample_chain(logits, empty_window(), jax.random.PRNGKey(7), st)
+    b = sample_chain(logits, empty_window(), jax.random.PRNGKey(7), st)
+    assert int(a) == int(b)
+
+
+def test_window_ring_buffer():
+    w, wpos = seed_window([1, 2, 3])
+    assert int(wpos) == 3
+    assert np.asarray(w)[:3].tolist() == [1, 2, 3]
+    w, wpos = update_window(w, wpos, jnp.int32(9))
+    assert int(np.asarray(w)[3]) == 9 and int(wpos) == 4
+
+    long_prompt = list(range(200))
+    w, wpos = seed_window(long_prompt)
+    assert set(np.asarray(w).tolist()) == set(range(136, 200))
