@@ -1,0 +1,2 @@
+from .engine import Engine  # noqa: F401
+from .fake import FakeEngine  # noqa: F401

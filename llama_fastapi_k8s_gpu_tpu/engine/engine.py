@@ -1,0 +1,345 @@
+"""The in-tree replacement for ``llama_cpp.Llama``.
+
+The reference constructs ``Llama(model_path, n_gpu_layers=-1, n_ctx=1024)`` at
+import time and calls ``create_chat_completion(...)`` from a worker thread
+(reference api.py:24-28, 55-63).  This class preserves that contract —
+eager load, blocking thread-safe generation, OpenAI-shaped responses and
+streaming chunks (SURVEY.md §2B) — on a JAX/TPU runtime:
+
+- load: GGUF mmap → dequant → HBM-resident params (bf16 or int8 by size);
+- prefill: jit'd, prompt length padded to the nearest bucket so the set of
+  compiled shapes is fixed (TTFT never pays a cold compile after warmup);
+- decode: on-device scanned chunks of N tokens per host round-trip, KV cache
+  and state donated so steady-state decode is allocation-free;
+- sampling: llama.cpp-parity chain; defaults match llama-cpp-python 0.2.77
+  (the reference relies on those defaults for top_k/min_p/repeat_penalty).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..gguf import GGUFFile
+from ..models.config import ModelConfig
+from ..models.generate import generate_chunk_jit, init_state, prefill_jit, sample_jit
+from ..models.llama import init_cache
+from ..models.params import load_params, synth_params
+from ..sampling.sample import SamplingParams, sampling_tensors, seed_window
+from ..tokenizer import apply_chat_template, detect_chat_template, tokenizer_from_gguf
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_BUCKETS = (128, 256, 512, 1024)
+
+
+class Engine:
+    """Loads a GGUF model and serves chat completions on the local device(s)."""
+
+    def __init__(
+        self,
+        model_path: str | None,
+        n_ctx: int = 1024,
+        weight_format: str = "auto",
+        decode_chunk: int = 8,
+        prefill_buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_gen_tokens: int = 512,
+        seed: int = 0,
+        *,
+        _parts: tuple | None = None,  # (params, cfg, tokenizer, template_kind)
+    ):
+        self.n_ctx = n_ctx
+        self.decode_chunk = decode_chunk
+        self.max_gen_tokens = max_gen_tokens
+        self._lock = threading.Lock()
+        self._base_seed = seed
+        self._requests = 0
+
+        if _parts is not None:
+            self.params, self.cfg, self.tokenizer, self.template_kind = _parts
+            self.model_name = "in-memory"
+        else:
+            t0 = time.time()
+            gf = GGUFFile(model_path)
+            self.model_name = gf.metadata.get("general.name", model_path)
+            self.cfg = ModelConfig.from_gguf(gf, n_ctx=n_ctx)
+            self.tokenizer = tokenizer_from_gguf(gf)
+            if weight_format == "auto":
+                # bf16 params ≈ 2 bytes/weight; pick int8 when a bf16 copy
+                # would crowd a 16 GB v5e HBM (≳ 4 GB of linear weights)
+                n_lin = self.cfg.n_layers * (
+                    4 * self.cfg.dim * self.cfg.dim // 1  # attn (approx)
+                    + 3 * self.cfg.dim * self.cfg.ffn_dim
+                )
+                weight_format = "int8" if n_lin * 2 > 4e9 else "bf16"
+            self.params = load_params(gf, self.cfg, weight_format)
+            self.template_kind = detect_chat_template(
+                gf.metadata.get("tokenizer.chat_template"), self.tokenizer
+            )
+            logger.info(
+                "loaded %s (%s, %d layers, fmt=%s) in %.1fs",
+                model_path, gf.architecture, self.cfg.n_layers, weight_format,
+                time.time() - t0,
+            )
+        self.prefill_buckets = sorted(b for b in prefill_buckets if b <= self.cfg.n_ctx)
+        if not self.prefill_buckets or self.prefill_buckets[-1] < self.cfg.n_ctx:
+            self.prefill_buckets.append(self.cfg.n_ctx)
+        self._cache = init_cache(self.cfg)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_parts(cls, params, cfg: ModelConfig, tokenizer,
+                   template_kind: str = "llama3", **kw) -> "Engine":
+        """Build from in-memory parts (tests, benches, synthetic models)."""
+        eng = cls(None, n_ctx=cfg.n_ctx,
+                  _parts=(params, cfg, tokenizer, template_kind), **kw)
+        return eng
+
+    @classmethod
+    def synthetic(cls, cfg: ModelConfig, tokenizer, fmt: str = "bf16",
+                  seed: int = 0, **kw) -> "Engine":
+        return cls.from_parts(synth_params(cfg, fmt=fmt, seed=seed), cfg,
+                              tokenizer, **kw)
+
+    # ------------------------------------------------------------------
+    def warmup(self):
+        """Compile every (bucket, chunk) shape so no request pays a cold
+        compile — the TPU analogue of the reference's eager model load."""
+        t0 = time.time()
+        msgs = [{"role": "user", "content": "hi"}]
+        self.create_chat_completion(msgs, max_tokens=self.decode_chunk + 1,
+                                    temperature=0.0)
+        for b in self.prefill_buckets[1:]:
+            ids = [0] * (b - 1)
+            cache = self._cache
+            logits, cache = prefill_jit(
+                self.params, self.cfg,
+                jnp.asarray(ids + [0], jnp.int32)[:b], jnp.int32(len(ids)), cache)
+            jax.block_until_ready(logits)
+            self._cache = cache
+        logger.info("warmup done in %.1fs (%d prefill buckets)",
+                    time.time() - t0, len(self.prefill_buckets))
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.cfg.n_ctx
+
+    def tokenize_messages(self, messages: Sequence[dict]) -> list[int]:
+        return apply_chat_template(self.tokenizer, messages, kind=self.template_kind)
+
+    # ------------------------------------------------------------------
+    def create_chat_completion(
+        self,
+        messages: Sequence[dict],
+        stream: bool = False,
+        temperature: float = 0.2,
+        top_p: float = 0.95,
+        top_k: int = 40,
+        min_p: float = 0.05,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
+        repeat_penalty: float = 1.1,
+        max_tokens: int | None = None,
+        stop: Sequence[str] | str | None = None,
+        seed: int | None = None,
+    ):
+        """OpenAI-chat-shaped completion (dict), or an iterator of chunks when
+        ``stream=True`` (reference call site: api.py:55-63; chunk schema per
+        SURVEY.md §2B "Streaming").  Safe to call from a worker thread."""
+        if stop is None:
+            stop = []
+        elif isinstance(stop, str):
+            stop = [stop]
+        sp = SamplingParams(
+            temperature=temperature, top_p=top_p, top_k=top_k, min_p=min_p,
+            frequency_penalty=frequency_penalty, presence_penalty=presence_penalty,
+            repeat_penalty=repeat_penalty,
+        )
+        if stream:
+            return self._generate_stream(messages, sp, max_tokens, stop, seed)
+        return self._generate(messages, sp, max_tokens, stop, seed)
+
+    # ------------------------------------------------------------------
+    def _start(self, messages, sp: SamplingParams, seed):
+        """Shared prefill + first-token path. Returns a mutable gen context."""
+        ids = self.tokenize_messages(messages)
+        n_prompt = len(ids)
+        if n_prompt >= self.cfg.n_ctx:
+            raise ValueError(
+                f"Requested tokens ({n_prompt}) exceed context window of {self.cfg.n_ctx}"
+            )
+        bucket = self._bucket_for(n_prompt)
+        padded = ids + [0] * (bucket - n_prompt)
+        st = sampling_tensors(sp)
+
+        if seed is None:
+            seed = self._base_seed + self._requests
+        self._requests += 1
+
+        logits, cache = prefill_jit(
+            self.params, self.cfg, jnp.asarray(padded, jnp.int32),
+            jnp.int32(n_prompt), self._cache,
+        )
+        window, wpos = seed_window(ids)
+        key = jax.random.PRNGKey(seed)
+        token, window, wpos, key = sample_jit(
+            logits, window, wpos, key, st, self.cfg, top_k=sp.top_k)
+        state = {
+            "cache": cache,
+            "pos": jnp.int32(n_prompt),
+            "token": token,
+            "window": window,
+            "wpos": wpos,
+            "key": key,
+        }
+        return {
+            "state": state, "st": st, "sp": sp, "n_prompt": n_prompt,
+            "ids": [], "first": int(token),
+        }
+
+    def _finish(self, ctx):
+        """Return the cache buffer for reuse by the next request."""
+        self._cache = ctx["state"]["cache"]
+
+    def _token_budget(self, max_tokens, n_prompt):
+        budget = self.max_gen_tokens if max_tokens is None else max_tokens
+        return max(0, min(budget, self.cfg.n_ctx - n_prompt - 1))
+
+    def _decode_text(self, all_ids):
+        return self.tokenizer.decode(all_ids, skip_special=True)
+
+    @staticmethod
+    def _find_stop_str(text: str, stops) -> int:
+        cut = -1
+        for s in stops:
+            i = text.find(s)
+            if i != -1 and (cut == -1 or i < cut):
+                cut = i
+        return cut
+
+    def _run(self, ctx, max_tokens, stops):
+        """Generate tokens; yields (new_text, done, finish_reason) increments."""
+        stop_ids = self.tokenizer.stop_ids
+        budget = self._token_budget(max_tokens, ctx["n_prompt"])
+        gen: list[int] = []
+        emitted = ""
+        finish = "length"
+        first = ctx["first"]
+        if budget <= 0:
+            yield "", True, "length"
+            return
+        if first in stop_ids:
+            yield "", True, "stop"
+            return
+        gen.append(first)
+
+        done = False
+        while not done:
+            remaining = budget - len(gen)
+            if remaining <= 0:
+                finish = "length"
+                break
+            n_steps = min(self.decode_chunk, remaining)
+            # cache slots: positions n_prompt .. n_ctx-1
+            if int(ctx["state"]["pos"]) + n_steps >= self.cfg.n_ctx:
+                n_steps = self.cfg.n_ctx - int(ctx["state"]["pos"]) - 1
+                if n_steps <= 0:
+                    finish = "length"
+                    break
+            ctx["state"], tokens = generate_chunk_jit(
+                self.params, self.cfg, ctx["state"], ctx["st"],
+                n_steps=n_steps, top_k=ctx["sp"].top_k,
+            )
+            for t in np.asarray(tokens).tolist():
+                if t in stop_ids:
+                    finish = "stop"
+                    done = True
+                    break
+                gen.append(t)
+
+            text = self._decode_text(gen)
+            cut = self._find_stop_str(text, stops)
+            if cut != -1:
+                text = text[:cut]
+                finish = "stop"
+                done = True
+            # hold back a trailing replacement char (partial UTF-8 sequence)
+            safe = text
+            if not done and safe.endswith("�"):
+                safe = safe[:-1]
+            if len(safe) > len(emitted):
+                yield safe[len(emitted):], False, finish
+                emitted = safe
+
+        text = self._decode_text(gen)
+        cut = self._find_stop_str(text, stops)
+        if cut != -1:
+            text = text[:cut]
+        ctx["ids"] = gen
+        yield text[len(emitted):] if len(text) > len(emitted) else "", True, finish
+
+    # ------------------------------------------------------------------
+    def _generate(self, messages, sp, max_tokens, stops, seed) -> dict:
+        with self._lock:
+            t0 = time.time()
+            ctx = self._start(messages, sp, seed)
+            parts = []
+            finish = "stop"
+            for text, done, fr in self._run(ctx, max_tokens, stops):
+                parts.append(text)
+                finish = fr
+            self._finish(ctx)
+            content = "".join(parts)
+            completion_tokens = len(ctx["ids"])
+            logger.info("generation: %.2fs, finish=%s", time.time() - t0, finish)
+            return {
+                "id": f"chatcmpl-{uuid.uuid4().hex}",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": self.model_name,
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": content},
+                    "finish_reason": finish,
+                }],
+                "usage": {
+                    "prompt_tokens": ctx["n_prompt"],
+                    "completion_tokens": completion_tokens,
+                    "total_tokens": ctx["n_prompt"] + completion_tokens,
+                },
+            }
+
+    def _generate_stream(self, messages, sp, max_tokens, stops, seed) -> Iterator[dict]:
+        with self._lock:
+            ctx = self._start(messages, sp, seed)
+            cid = f"chatcmpl-{uuid.uuid4().hex}"
+            created = int(time.time())
+
+            def chunk(delta: dict, finish=None):
+                return {
+                    "id": cid,
+                    "object": "chat.completion.chunk",
+                    "created": created,
+                    "model": self.model_name,
+                    "choices": [{
+                        "index": 0, "delta": delta, "finish_reason": finish,
+                    }],
+                }
+
+            yield chunk({"role": "assistant"})
+            finish = "stop"
+            for text, done, fr in self._run(ctx, max_tokens, stops):
+                finish = fr
+                if text:
+                    yield chunk({"content": text})
+            self._finish(ctx)
+            yield chunk({}, finish=finish)

@@ -1,0 +1,64 @@
+"""Deterministic fake engine for server/integration tests.
+
+Implements the §2B response contract (SURVEY.md) with injectable latency and
+failures so the admission-control paths — queue-full 503, 25 s timeout 408,
+engine-error 500 (reference api.py:155-173) — can be exercised without a
+model or a device (SURVEY.md §4 "Integration").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+
+class FakeEngine:
+    def __init__(self, reply: str = "ok", delay: float = 0.0,
+                 fail: Exception | None = None):
+        self.reply = reply
+        self.delay = delay
+        self.fail = fail
+        self.calls: list[list[dict]] = []
+        self._lock = threading.Lock()
+
+    def warmup(self):
+        pass
+
+    def create_chat_completion(self, messages, stream=False, **kwargs):
+        with self._lock:
+            self.calls.append(list(messages))
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail is not None:
+            raise self.fail
+        content = self.reply
+        base = {
+            "id": f"chatcmpl-{uuid.uuid4().hex}",
+            "created": int(time.time()),
+            "model": "fake",
+        }
+        if not stream:
+            return {
+                **base,
+                "object": "chat.completion",
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": content},
+                    "finish_reason": "stop",
+                }],
+                "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                          "total_tokens": 2},
+            }
+
+        def gen():
+            yield {**base, "object": "chat.completion.chunk",
+                   "choices": [{"index": 0, "delta": {"role": "assistant"},
+                                "finish_reason": None}]}
+            for ch in content:
+                yield {**base, "object": "chat.completion.chunk",
+                       "choices": [{"index": 0, "delta": {"content": ch},
+                                    "finish_reason": None}]}
+            yield {**base, "object": "chat.completion.chunk",
+                   "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}]}
+        return gen()

@@ -1,0 +1,101 @@
+"""Test/bench fixtures: tiny synthetic models and GGUF files.
+
+No network egress exists in any deployment of this framework's CI or bench
+(BASELINE.md), so every test artifact is synthesized: byte-level vocabularies
+and random weights written through the real GGUF writer, then loaded through
+the real reader/dequant/tokenizer/model path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .gguf import GGMLType, GGUFWriter
+from .models.config import ModelConfig
+from .tokenizer.base import TokenType
+from .tokenizer.bpe import bytes_to_unicode
+
+TINY_CFG = ModelConfig(
+    vocab_size=256 + 7, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, n_ctx=128, rope_theta=10000.0,
+)
+
+LLAMA3_SPECIALS = [
+    "<|begin_of_text|>", "<|end_of_text|>", "<|start_header_id|>",
+    "<|end_header_id|>", "<|eot_id|>", "<|python_tag|>", "<|eom_id|>",
+]
+
+
+def byte_vocab_with_specials() -> tuple[list[str], list[int]]:
+    """256 byte tokens + llama-3 control tokens; ids stable and dense."""
+    tokens = [bytes_to_unicode()[b] for b in range(256)] + list(LLAMA3_SPECIALS)
+    types = [int(TokenType.NORMAL)] * 256 + [int(TokenType.CONTROL)] * len(LLAMA3_SPECIALS)
+    return tokens, types
+
+
+def write_tiny_llama_gguf(
+    path: str,
+    cfg: ModelConfig = TINY_CFG,
+    seed: int = 0,
+    quant: GGMLType = GGMLType.Q8_0,
+    ffn_quant: GGMLType | None = None,
+) -> ModelConfig:
+    """Write a random-weight llama GGUF with a byte-level BPE tokenizer.
+
+    vocab_size is forced to 256+len(specials) so every byte is encodable.
+    """
+    tokens, types = byte_vocab_with_specials()
+    cfg = ModelConfig(**{**cfg.__dict__, "vocab_size": len(tokens)})
+    rng = np.random.default_rng(seed)
+    scale = cfg.dim ** -0.5
+
+    w = GGUFWriter(path)
+    w.add_metadata("general.architecture", "llama")
+    w.add_metadata("general.name", "tiny-llama-test")
+    w.add_metadata("llama.block_count", cfg.n_layers)
+    w.add_metadata("llama.context_length", cfg.n_ctx)
+    w.add_metadata("llama.embedding_length", cfg.dim)
+    w.add_metadata("llama.feed_forward_length", cfg.ffn_dim)
+    w.add_metadata("llama.attention.head_count", cfg.n_heads)
+    w.add_metadata("llama.attention.head_count_kv", cfg.n_kv_heads)
+    w.add_metadata("llama.attention.layer_norm_rms_epsilon", cfg.rms_eps)
+    w.add_metadata("llama.rope.freq_base", cfg.rope_theta)
+    w.add_metadata("llama.vocab_size", cfg.vocab_size)
+    if cfg.sliding_window:
+        w.add_metadata("llama.attention.sliding_window", cfg.sliding_window)
+    w.add_metadata("tokenizer.ggml.model", "gpt2")
+    w.add_metadata("tokenizer.ggml.pre", "llama-bpe")
+    w.add_metadata("tokenizer.ggml.tokens", tokens)
+    w.add_metadata("tokenizer.ggml.token_type", types)
+    w.add_metadata("tokenizer.ggml.merges", [])
+    w.add_metadata("tokenizer.ggml.bos_token_id", tokens.index("<|begin_of_text|>"))
+    w.add_metadata("tokenizer.ggml.eos_token_id", tokens.index("<|eot_id|>"))
+    w.add_metadata(
+        "tokenizer.chat_template",
+        "{{bos_token}}{% for m in messages %}<|start_header_id|>{{m['role']}}"
+        "<|end_header_id|>\n\n{{m['content']}}<|eot_id|>{% endfor %}",
+    )
+
+    if ffn_quant is None:
+        ffn_quant = quant
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+
+    def t(name, shape, gtype):
+        w.add_tensor(name, rng.standard_normal(shape).astype(np.float32) * scale, gtype)
+
+    t("token_embd.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
+    for i in range(cfg.n_layers):
+        p = f"blk.{i}."
+        t(p + "attn_norm.weight", (cfg.dim,), GGMLType.F32)
+        t(p + "attn_q.weight", (cfg.dim, cfg.dim), quant)
+        t(p + "attn_k.weight", (kv_dim, cfg.dim), quant)
+        t(p + "attn_v.weight", (kv_dim, cfg.dim), quant)
+        t(p + "attn_output.weight", (cfg.dim, cfg.dim), quant)
+        t(p + "ffn_norm.weight", (cfg.dim,), GGMLType.F32)
+        t(p + "ffn_gate.weight", (cfg.ffn_dim, cfg.dim), ffn_quant)
+        t(p + "ffn_up.weight", (cfg.ffn_dim, cfg.dim), ffn_quant)
+        t(p + "ffn_down.weight", (cfg.dim, cfg.ffn_dim), ffn_quant)
+    t("output_norm.weight", (cfg.dim,), GGMLType.F32)
+    t("output.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
+    w.write()
+    return cfg

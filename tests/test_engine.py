@@ -1,0 +1,93 @@
+"""Engine end-to-end tests: the "minimum slice" milestone of SURVEY.md §7 —
+GGUF file → load → tokenize → prefill/decode → OpenAI-shaped response, all on
+the XLA-CPU backend with a tiny synthesized model."""
+
+import numpy as np
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.engine import Engine
+from llama_fastapi_k8s_gpu_tpu.gguf import GGMLType
+from llama_fastapi_k8s_gpu_tpu.testing import TINY_CFG, write_tiny_llama_gguf
+
+MSGS = [
+    {"role": "system", "content": "You are a test bot."},
+    {"role": "user", "content": "Say something."},
+]
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("model") / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    eng = Engine(path, n_ctx=128, decode_chunk=4, max_gen_tokens=32,
+                 prefill_buckets=(32, 64, 128))
+    return eng
+
+
+def test_response_shape(engine):
+    out = engine.create_chat_completion(MSGS, max_tokens=8, seed=0)
+    assert out["object"] == "chat.completion"
+    assert isinstance(out["choices"], list) and len(out["choices"]) == 1
+    choice = out["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert choice["finish_reason"] in ("stop", "length")
+    u = out["usage"]
+    assert u["prompt_tokens"] > 0
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+    assert u["completion_tokens"] <= 8
+
+
+def test_greedy_deterministic(engine):
+    a = engine.create_chat_completion(MSGS, temperature=0.0, max_tokens=8)
+    b = engine.create_chat_completion(MSGS, temperature=0.0, max_tokens=8)
+    assert a["choices"][0]["message"]["content"] == b["choices"][0]["message"]["content"]
+
+
+def test_seeded_sampling_deterministic(engine):
+    a = engine.create_chat_completion(MSGS, temperature=1.0, max_tokens=8, seed=42)
+    b = engine.create_chat_completion(MSGS, temperature=1.0, max_tokens=8, seed=42)
+    assert a["choices"][0]["message"]["content"] == b["choices"][0]["message"]["content"]
+
+
+def test_streaming_matches_non_streaming(engine):
+    kw = dict(temperature=0.0, max_tokens=8)
+    full = engine.create_chat_completion(MSGS, **kw)
+    chunks = list(engine.create_chat_completion(MSGS, stream=True, **kw))
+    assert chunks[0]["choices"][0]["delta"] == {"role": "assistant"}
+    assert chunks[0]["object"] == "chat.completion.chunk"
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    text = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+    assert text == full["choices"][0]["message"]["content"]
+
+
+def test_max_tokens_finish_length(engine):
+    out = engine.create_chat_completion(MSGS, temperature=0.0, max_tokens=2)
+    assert out["usage"]["completion_tokens"] <= 2
+
+
+def test_prompt_too_long_raises(engine):
+    msgs = [{"role": "user", "content": "x" * 2000}]
+    with pytest.raises(ValueError, match="exceed context window"):
+        engine.create_chat_completion(msgs)
+
+
+def test_q4k_model_loads(tmp_path):
+    """K-quant load path end-to-end: dims must be multiples of 256."""
+    from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig(vocab_size=263, dim=256, n_layers=1, n_heads=4,
+                      n_kv_heads=2, ffn_dim=256, n_ctx=64, rope_theta=1e4)
+    path = str(tmp_path / "q4k.gguf")
+    write_tiny_llama_gguf(path, cfg, quant=GGMLType.Q4_K, ffn_quant=GGMLType.Q6_K)
+    eng = Engine(path, n_ctx=64, decode_chunk=2, max_gen_tokens=4,
+                 prefill_buckets=(32, 64))
+    out = eng.create_chat_completion([{"role": "user", "content": "hi"}],
+                                     temperature=0.0, max_tokens=3)
+    assert isinstance(out["choices"][0]["message"]["content"], str)
+
+
+def test_usage_counts_against_tokenizer(engine):
+    out = engine.create_chat_completion(MSGS, temperature=0.0, max_tokens=8)
+    ids = engine.tokenize_messages(MSGS)
+    assert out["usage"]["prompt_tokens"] == len(ids)
