@@ -1,0 +1,2 @@
+from .app import create_app  # noqa: F401
+from .schemas import BotMessageRequest, BotProfile, ChatMessage, UserProfile  # noqa: F401

@@ -1,0 +1,29 @@
+"""``python -m llama_fastapi_k8s_gpu_tpu.server`` — run the service.
+
+Uses uvicorn when available (the production image installs it, mirroring the
+reference's gunicorn+UvicornWorker, reference docker/Dockerfile.app:12);
+otherwise falls back to the in-tree dependency-free ``httpd``.  Either way
+there is exactly one worker process: the model is loaded once per process, so
+``-w 1`` is load-bearing (SURVEY.md §1 L4).
+"""
+
+import os
+
+
+def main():
+    host = os.environ.get("LFKT_HOST", "0.0.0.0")
+    port = int(os.environ.get("LFKT_PORT", "8000"))
+    try:
+        import uvicorn
+    except ImportError:
+        from .app import app
+        from .httpd import run
+
+        run(app, host, port)
+        return
+    uvicorn.run("llama_fastapi_k8s_gpu_tpu.server.app:app",
+                host=host, port=port, workers=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,266 @@
+"""FastAPI serving layer.
+
+Preserves the reference's externally observable behavior line by line
+(reference api.py; SURVEY.md §2A #3-#8):
+
+- ``POST /response`` with the same schema, the same system-prompt assembly
+  quirks (insert at index 1, ``.f`` name-suffix gender clause,
+  ``appearance.split(",")[3:]`` fact append — api.py:127-147), the same
+  truncation (400-char clip, chars/4 estimate, pop-index-2 loop —
+  api.py:30-46), and the same admission control: bounded queue(5) → 503,
+  single consumer + semaphore(1) → strictly serial generation, 25 s future
+  timeout → 408 with cancellation, engine errors → 500 (api.py:80-173).
+- the vestigial ``GET /items/{item_id}`` echo route (api.py:175-177).
+- the request-timing log middleware (api.py:179-194).
+
+Additions the reference advertises but lacks (SURVEY.md §2C): ``GET /health``
+(model/device/queue state, wired for k8s probes) and ``GET /metrics``
+(Prometheus text).  All constants are env-overridable with identical defaults
+(utils/config.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from datetime import datetime
+
+from .asgikit import HTTPException, MicroAPI, PlainTextResponse, Request
+
+from ..utils.config import Settings, get_settings
+from ..utils.metrics import Metrics
+from .schemas import BotMessageRequest
+
+logging.basicConfig(level=logging.INFO)
+logger = logging.getLogger(__name__)
+
+
+def count_tokens_roughly(text: str) -> int:
+    # chars/4 heuristic, identical to reference api.py:30-32
+    return int(len(text) / 4.0)
+
+
+def truncate_messages_to_fit_context(messages: list[dict], max_tokens: int) -> list[dict]:
+    """Identical behavior to reference api.py:35-46: clip every message to 400
+    chars (in place), then drop messages[2] (oldest after the pinned first
+    context message and the system prompt at index 1) until under budget."""
+    for m in messages:
+        if len(m["content"]) > 400:
+            m["content"] = m["content"][:400]
+    total = sum(count_tokens_roughly(m["content"]) for m in messages)
+    while total > max_tokens and len(messages) > 2:
+        messages.pop(2)
+        total = sum(count_tokens_roughly(m["content"]) for m in messages)
+    return messages
+
+
+def build_system_prompt(bot_profile) -> str:
+    """Reference api.py:127-145: explicit system_prompt wins; otherwise a
+    default persona interpolating the bot name; then the name-suffix gender
+    clause and the appearance facts after the third comma."""
+    system_prompt = bot_profile.system_prompt
+    if not system_prompt:
+        system_prompt = (
+            f"Stay in character at all times and never break it. "
+            f"Reject violent messages. "
+            f"You are '{bot_profile.name}', a playful, flirtatious and witty "
+            f"person who keeps the conversation fun and engaging, never "
+            f"mentions AI, restrictions or policies, and answers the user's "
+            f"questions directly."
+        )
+    if bot_profile.name.endswith(".f"):
+        system_prompt += " You a girl."
+    else:
+        system_prompt += " You a boy."
+    for fact in bot_profile.appearance.split(",")[3:]:
+        system_prompt += fact
+    return system_prompt
+
+
+def create_app(engine=None, settings: Settings | None = None,
+               engine_factory=None) -> MicroAPI:
+    """Build the app. ``engine`` (or ``engine_factory``, called at startup)
+    must provide ``create_chat_completion``; defaults to loading the GGUF
+    named by settings — the eager-load equivalent of reference api.py:24-28."""
+    settings = settings or get_settings()
+    app = MicroAPI(title="chat-ai (tpu)", version="0.1.0")
+    app.state.settings = settings
+    app.state.engine = engine
+    app.state.metrics = Metrics()
+    app.state.ready = engine is not None
+
+    async def consumer():
+        """Single drain task: strict FIFO, one generation at a time
+        (reference api.py:80-107)."""
+        queue = app.state.queue
+        semaphore = app.state.semaphore
+        while True:
+            request_data = await queue.get()
+            messages = request_data["messages"]
+            future = request_data["future"]
+            if future.cancelled():
+                logger.info("Future was cancelled before processing; skipping.")
+                queue.task_done()
+                continue
+            try:
+                response = await _truncate_and_generate(messages, semaphore)
+                if not future.cancelled():
+                    future.set_result(response)
+                else:
+                    logger.info("Future cancelled during processing; result dropped.")
+            except Exception as e:  # noqa: BLE001 — must never kill the consumer
+                if not future.cancelled():
+                    future.set_exception(e)
+                else:
+                    logger.info("Future cancelled during processing; error dropped.")
+            finally:
+                queue.task_done()
+
+    async def _truncate_and_generate(messages, semaphore) -> str:
+        m = app.state.metrics
+        async with semaphore:  # one generation at a time (reference api.py:50)
+            try:
+                messages = truncate_messages_to_fit_context(
+                    messages, settings.max_context_tokens)
+                t0 = time.time()
+                answer = await asyncio.to_thread(
+                    app.state.engine.create_chat_completion,
+                    messages=messages,
+                    stream=False,
+                    temperature=settings.temperature,
+                    top_p=settings.top_p,
+                    frequency_penalty=settings.frequency_penalty,
+                    presence_penalty=settings.presence_penalty,
+                )
+                m.observe("generation_seconds", time.time() - t0)
+                if not isinstance(answer, dict):
+                    logger.error("Unexpected response type: %s. Response: %s",
+                                 type(answer), answer)
+                    raise HTTPException(status_code=500,
+                                        detail="Unexpected response from model")
+                usage = answer.get("usage") or {}
+                if usage.get("completion_tokens"):
+                    m.inc("generated_tokens_total", usage["completion_tokens"])
+                response = ""
+                for choice in answer.get("choices", []):
+                    if "message" in choice:
+                        response += choice["message"]["content"]
+                return response
+            except HTTPException:
+                raise
+            except Exception as e:  # noqa: BLE001 — 500 semantics, api.py:76-78
+                logger.error("Error during message generation: %s", e)
+                raise HTTPException(
+                    status_code=500,
+                    detail=f"Error during message generation: {str(e)}",
+                ) from e
+
+    @app.on_event("startup")
+    async def startup_event():
+        app.state.queue = asyncio.Queue(maxsize=settings.max_queue_size)
+        app.state.semaphore = asyncio.Semaphore(1)
+        if app.state.engine is None:
+            factory = engine_factory or _default_engine_factory(settings)
+            loop = asyncio.get_running_loop()
+            app.state.engine = await loop.run_in_executor(None, factory)
+        app.state.ready = True
+        app.state.consumer_task = asyncio.create_task(consumer())
+
+    @app.post("/response")
+    async def generate_response(request_body: BotMessageRequest, request: Request):
+        queue = request.app.state.queue
+        m = request.app.state.metrics
+        messages = [
+            {"role": message.turn, "content": message.message}
+            for message in request_body.context
+        ]
+        system_prompt = build_system_prompt(request_body.bot_profile)
+        # index 1, not 0 — quirk preserved from reference api.py:147
+        messages.insert(1, {"role": "system", "content": system_prompt})
+
+        loop = asyncio.get_running_loop()
+        future = loop.create_future()
+        try:
+            queue.put_nowait({"messages": messages, "future": future})
+        except asyncio.QueueFull:
+            m.inc("requests_rejected_total")
+            raise HTTPException(status_code=503,
+                                detail="Server too busy. Please try again later.")
+        m.set_gauge("queue_depth", queue.qsize())
+        try:
+            response = await asyncio.wait_for(future, timeout=settings.timeout_seconds)
+            return {"response": response}
+        except asyncio.TimeoutError:
+            logger.warning("Generation timed out")
+            m.inc("requests_timed_out_total")
+            future.cancel()
+            raise HTTPException(status_code=408, detail="Generation timed out")
+        except HTTPException:
+            raise
+        except Exception as e:  # noqa: BLE001 — api.py:171-173
+            logger.error("Internal server error: %s", e)
+            raise HTTPException(status_code=500,
+                                detail=f"Internal server error: {str(e)}")
+
+    @app.get("/health")
+    async def health():
+        """Advertised by the reference README (README.md:14) but never
+        implemented (SURVEY.md §3.5); serves k8s liveness/readiness."""
+        st = app.state
+        queue_depth = st.queue.qsize() if hasattr(st, "queue") else None
+        if not st.ready:
+            raise HTTPException(status_code=503, detail="model loading")
+        return {
+            "status": "ok",
+            "model_loaded": st.engine is not None,
+            "queue_depth": queue_depth,
+            "max_queue_size": st.settings.max_queue_size,
+        }
+
+    @app.get("/metrics")
+    async def metrics():
+        m = app.state.metrics
+        if hasattr(app.state, "queue"):
+            m.set_gauge("queue_depth", app.state.queue.qsize())
+        return PlainTextResponse(m.render())
+
+    @app.get("/items/{item_id}")
+    async def read_item(item_id: int):
+        # vestigial echo route kept for OpenAPI-surface parity (api.py:175-177)
+        return {"item_id": item_id}
+
+    @app.middleware("http")
+    async def log_request_time(request: Request, call_next):
+        start_time = time.time()
+        response = await call_next(request)
+        time_of_day = datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+        process_time = time.time() - start_time
+        app.state.metrics.observe("request_seconds", process_time)
+        logger.info(
+            "Request at %s: %s %s completed in %.4fs",
+            time_of_day, request.method, request.url, process_time,
+        )
+        return response
+
+    return app
+
+
+def _default_engine_factory(settings: Settings):
+    def factory():
+        from ..engine import Engine
+
+        eng = Engine(
+            settings.model_path,
+            n_ctx=settings.max_context_tokens,
+            weight_format=settings.weight_format,
+            decode_chunk=settings.decode_chunk,
+            prefill_buckets=settings.prefill_bucket_list,
+            max_gen_tokens=settings.max_gen_tokens,
+        )
+        eng.warmup()
+        return eng
+    return factory
+
+
+app = create_app()

@@ -1,0 +1,369 @@
+"""In-tree ASGI micro-framework (FastAPI-compatible subset).
+
+The reference builds on FastAPI + uvicorn + gunicorn (reference
+docker/requirements.txt:1-4, Dockerfile.app:12).  This module provides the
+subset of that surface the service actually uses — decorator routing with
+path parameters, pydantic request-body validation (422s), ``HTTPException``
+with a ``{"detail": ...}`` body, ``@app.middleware("http")``,
+``@app.on_event("startup")``, ``app.state`` — as a plain ASGI app with zero
+dependencies beyond pydantic.  The app runs under any ASGI server (uvicorn in
+the production image, the in-tree ``httpd`` for dev/test) and is driven
+in-process by ``httpx.ASGITransport`` in tests.
+
+An ``/openapi.json`` document and a minimal ``/docs`` page are generated from
+the registered routes, preserving the reference's advertised OpenAPI surface
+(reference README.md:14).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import re
+import traceback
+import logging
+import typing
+from typing import Any, Awaitable, Callable
+
+import pydantic
+
+logger = logging.getLogger(__name__)
+
+
+class HTTPException(Exception):
+    def __init__(self, status_code: int, detail: Any = None):
+        self.status_code = status_code
+        self.detail = detail
+        super().__init__(detail)
+
+
+class State:
+    """Attribute bag (FastAPI's app.state)."""
+
+
+class URL:
+    def __init__(self, scope: dict):
+        self.path = scope.get("path", "/")
+        self.query = scope.get("query_string", b"").decode()
+        host = dict(scope.get("headers") or {}).get(b"host", b"").decode()
+        self.scheme = scope.get("scheme", "http")
+        self._str = f"{self.scheme}://{host}{self.path}" + (
+            f"?{self.query}" if self.query else ""
+        )
+
+    def __str__(self):
+        return self._str
+
+
+class Request:
+    def __init__(self, app: "MicroAPI", scope: dict, body: bytes):
+        self.app = app
+        self.scope = scope
+        self.method = scope.get("method", "GET")
+        self.url = URL(scope)
+        self.path_params: dict[str, Any] = {}
+        self._body = body
+
+    async def body(self) -> bytes:
+        return self._body
+
+    async def json(self):
+        return json.loads(self._body or b"null")
+
+
+class Response:
+    media_type = "application/octet-stream"
+
+    def __init__(self, content: Any = b"", status_code: int = 200,
+                 headers: dict[str, str] | None = None,
+                 media_type: str | None = None):
+        self.status_code = status_code
+        self.headers = dict(headers or {})
+        self.media_type = media_type or self.media_type
+        self.body = self.render(content)
+
+    def render(self, content) -> bytes:
+        if isinstance(content, bytes):
+            return content
+        return str(content).encode()
+
+
+class PlainTextResponse(Response):
+    media_type = "text/plain; charset=utf-8"
+
+
+class HTMLResponse(Response):
+    media_type = "text/html; charset=utf-8"
+
+
+class JSONResponse(Response):
+    media_type = "application/json"
+
+    def render(self, content) -> bytes:
+        return json.dumps(content).encode()
+
+
+class _Route:
+    _PARAM_RE = re.compile(r"{(\w+)}")
+
+    def __init__(self, method: str, path: str, handler: Callable):
+        self.method = method
+        self.path = path
+        self.handler = handler
+        pattern = self._PARAM_RE.sub(r"(?P<\1>[^/]+)", path)
+        self.regex = re.compile(f"^{pattern}$")
+        self.signature = inspect.signature(handler)
+        # resolve string annotations (PEP 563 `from __future__ import annotations`)
+        try:
+            self.annotations = typing.get_type_hints(handler)
+        except Exception:  # noqa: BLE001 — fall back to raw annotations
+            self.annotations = {
+                n: p.annotation for n, p in self.signature.parameters.items()
+            }
+
+    def annotation(self, name: str):
+        return self.annotations.get(name, inspect.Parameter.empty)
+
+    def match(self, method: str, path: str):
+        m = self.regex.match(path)
+        if not m:
+            return None
+        return m.groupdict()
+
+
+class _Router:
+    """Holds routes + lifecycle hooks; exposes startup()/shutdown() like
+    starlette's router (used directly by in-process tests)."""
+
+    def __init__(self):
+        self.routes: list[_Route] = []
+        self.on_startup: list[Callable] = []
+        self.on_shutdown: list[Callable] = []
+
+    async def startup(self):
+        for fn in self.on_startup:
+            res = fn()
+            if inspect.isawaitable(res):
+                await res
+
+    async def shutdown(self):
+        for fn in self.on_shutdown:
+            res = fn()
+            if inspect.isawaitable(res):
+                await res
+
+
+class MicroAPI:
+    def __init__(self, title: str = "app", version: str = "0.1.0"):
+        self.title = title
+        self.version = version
+        self.state = State()
+        self.router = _Router()
+        self._middlewares: list[Callable] = []
+        self._add_builtin_routes()
+
+    # -- registration ------------------------------------------------------
+    def _register(self, method: str, path: str):
+        def deco(fn):
+            self.router.routes.append(_Route(method, path, fn))
+            return fn
+        return deco
+
+    def get(self, path: str):
+        return self._register("GET", path)
+
+    def post(self, path: str):
+        return self._register("POST", path)
+
+    def on_event(self, name: str):
+        def deco(fn):
+            if name == "startup":
+                self.router.on_startup.append(fn)
+            elif name == "shutdown":
+                self.router.on_shutdown.append(fn)
+            return fn
+        return deco
+
+    def middleware(self, kind: str):
+        assert kind == "http"
+
+        def deco(fn):
+            self._middlewares.append(fn)
+            return fn
+        return deco
+
+    # -- request handling --------------------------------------------------
+    async def _dispatch(self, request: Request) -> Response:
+        path = request.url.path
+        matched_path = False
+        for route in self.router.routes:
+            params = route.match(request.method, path)
+            if params is None:
+                continue
+            matched_path = True
+            if route.method != request.method:
+                continue
+            request.path_params = params
+            return await self._call_handler(route, request)
+        if matched_path:
+            return JSONResponse({"detail": "Method Not Allowed"}, 405)
+        return JSONResponse({"detail": "Not Found"}, 404)
+
+    async def _call_handler(self, route: _Route, request: Request) -> Response:
+        kwargs: dict[str, Any] = {}
+        for name, param in route.signature.parameters.items():
+            ann = route.annotation(name)
+            if ann is Request or name == "request":
+                kwargs[name] = request
+            elif isinstance(ann, type) and issubclass(ann, pydantic.BaseModel):
+                try:
+                    payload = await request.json()
+                except json.JSONDecodeError:
+                    return JSONResponse({"detail": "Invalid JSON body"}, 422)
+                try:
+                    kwargs[name] = ann.model_validate(payload)
+                except pydantic.ValidationError as e:
+                    return JSONResponse({"detail": e.errors(include_url=False)}, 422)
+            elif name in request.path_params:
+                value = request.path_params[name]
+                if ann is int:
+                    try:
+                        value = int(value)
+                    except ValueError:
+                        return JSONResponse(
+                            {"detail": f"Invalid int path param {name!r}"}, 422)
+                kwargs[name] = value
+        result = route.handler(**kwargs)
+        if inspect.isawaitable(result):
+            result = await result
+        if isinstance(result, Response):
+            return result
+        return JSONResponse(result)
+
+    async def _handle(self, request: Request) -> Response:
+        async def endpoint(req: Request) -> Response:
+            try:
+                return await self._dispatch(req)
+            except HTTPException as e:
+                return JSONResponse({"detail": e.detail}, e.status_code)
+            except Exception:  # noqa: BLE001
+                logger.error("Unhandled error:\n%s", traceback.format_exc())
+                return JSONResponse({"detail": "Internal Server Error"}, 500)
+
+        call_next: Callable[[Request], Awaitable[Response]] = endpoint
+        for mw in reversed(self._middlewares):
+            call_next = _bind_middleware(mw, call_next)
+        try:
+            return await call_next(request)
+        except HTTPException as e:
+            # a middleware may surface handler HTTPExceptions
+            return JSONResponse({"detail": e.detail}, e.status_code)
+
+    # -- ASGI --------------------------------------------------------------
+    async def __call__(self, scope, receive, send):
+        if scope["type"] == "lifespan":
+            while True:
+                message = await receive()
+                if message["type"] == "lifespan.startup":
+                    try:
+                        await self.router.startup()
+                        await send({"type": "lifespan.startup.complete"})
+                    except Exception as e:  # noqa: BLE001
+                        await send({"type": "lifespan.startup.failed",
+                                    "message": str(e)})
+                elif message["type"] == "lifespan.shutdown":
+                    try:
+                        await self.router.shutdown()
+                        await send({"type": "lifespan.shutdown.complete"})
+                    except Exception as e:  # noqa: BLE001
+                        await send({"type": "lifespan.shutdown.failed",
+                                    "message": str(e)})
+                    return
+            # unreachable
+        if scope["type"] != "http":
+            raise RuntimeError(f"unsupported ASGI scope {scope['type']}")
+
+        body = b""
+        while True:
+            message = await receive()
+            if message["type"] == "http.request":
+                body += message.get("body", b"")
+                if not message.get("more_body"):
+                    break
+            elif message["type"] == "http.disconnect":
+                return
+
+        request = Request(self, scope, body)
+        response = await self._handle(request)
+        headers = [(b"content-type", response.media_type.encode()),
+                   (b"content-length", str(len(response.body)).encode())]
+        headers += [(k.encode(), v.encode()) for k, v in response.headers.items()]
+        await send({"type": "http.response.start",
+                    "status": response.status_code, "headers": headers})
+        await send({"type": "http.response.body", "body": response.body})
+
+    # -- openapi -----------------------------------------------------------
+    def openapi(self) -> dict:
+        paths: dict[str, dict] = {}
+        for route in self.router.routes:
+            if route.path in ("/openapi.json", "/docs"):
+                continue
+            entry = paths.setdefault(route.path, {})
+            op: dict[str, Any] = {
+                "summary": (route.handler.__doc__ or "").strip().split("\n")[0],
+                "operationId": route.handler.__name__,
+                "responses": {"200": {"description": "Successful Response"}},
+            }
+            for name, param in route.signature.parameters.items():
+                ann = route.annotation(name)
+                if isinstance(ann, type) and issubclass(ann, pydantic.BaseModel):
+                    op["requestBody"] = {
+                        "content": {"application/json": {
+                            "schema": ann.model_json_schema()}},
+                        "required": True,
+                    }
+            params = _Route._PARAM_RE.findall(route.path)
+            if params:
+                op["parameters"] = [
+                    {"name": p, "in": "path", "required": True,
+                     "schema": {"type": "integer"
+                                if route.annotation(p) is int else "string"}}
+                    for p in params
+                ]
+            entry[route.method.lower()] = op
+        return {
+            "openapi": "3.1.0",
+            "info": {"title": self.title, "version": self.version},
+            "paths": paths,
+        }
+
+    def _add_builtin_routes(self):
+        @self.get("/openapi.json")
+        async def openapi_json():
+            return JSONResponse(self.openapi())
+
+        @self.get("/docs")
+        async def docs():
+            rows = []
+            for route in self.router.routes:
+                if route.path in ("/openapi.json", "/docs"):
+                    continue
+                doc = (route.handler.__doc__ or "").strip().split("\n")[0]
+                rows.append(
+                    f"<tr><td><code>{route.method}</code></td>"
+                    f"<td><code>{route.path}</code></td><td>{doc}</td></tr>")
+            html = (
+                f"<html><head><title>{self.title} — docs</title></head><body>"
+                f"<h1>{self.title} <small>{self.version}</small></h1>"
+                f"<p>OpenAPI JSON: <a href='/openapi.json'>/openapi.json</a></p>"
+                f"<table border=1 cellpadding=6><tr><th>method</th><th>path</th>"
+                f"<th>summary</th></tr>{''.join(rows)}</table></body></html>"
+            )
+            return HTMLResponse(html)
+
+
+def _bind_middleware(mw, nxt):
+    async def bound(request: Request) -> Response:
+        return await mw(request, nxt)
+    return bound

@@ -1,0 +1,127 @@
+"""Dependency-free asyncio HTTP/1.1 server for ASGI apps.
+
+Stands in for uvicorn (reference docker/Dockerfile.app:12) when serving the
+in-tree ASGI app without external packages: persistent connections,
+Content-Length framing, graceful shutdown via the ASGI lifespan protocol.
+One process, one event loop — the reference's single-worker model
+(``gunicorn -w 1``) is preserved by construction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+
+logger = logging.getLogger(__name__)
+
+_REASONS = {
+    200: "OK", 404: "Not Found", 405: "Method Not Allowed", 408: "Request Timeout",
+    422: "Unprocessable Entity", 500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+async def _handle_connection(app, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter):
+    peer = writer.get_extra_info("peername")
+    try:
+        while True:
+            request_line = await reader.readline()
+            if not request_line:
+                break
+            try:
+                method, target, _version = request_line.decode().split()
+            except ValueError:
+                break
+            headers = []
+            content_length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode().partition(":")
+                name = name.strip().lower()
+                value = value.strip()
+                headers.append((name.encode(), value.encode()))
+                if name == "content-length":
+                    content_length = int(value)
+            body = await reader.readexactly(content_length) if content_length else b""
+
+            path, _, query = target.partition("?")
+            scope = {
+                "type": "http",
+                "asgi": {"version": "3.0"},
+                "http_version": "1.1",
+                "method": method.upper(),
+                "path": path,
+                "query_string": query.encode(),
+                "headers": headers,
+                "client": peer,
+                "scheme": "http",
+            }
+
+            messages = [{"type": "http.request", "body": body, "more_body": False}]
+
+            async def receive():
+                if messages:
+                    return messages.pop(0)
+                return {"type": "http.disconnect"}
+
+            response = {"status": 500, "headers": [], "body": b""}
+
+            async def send(message):
+                if message["type"] == "http.response.start":
+                    response["status"] = message["status"]
+                    response["headers"] = message.get("headers", [])
+                elif message["type"] == "http.response.body":
+                    response["body"] += message.get("body", b"")
+
+            await app(scope, receive, send)
+
+            status = response["status"]
+            reason = _REASONS.get(status, "")
+            head = [f"HTTP/1.1 {status} {reason}".encode()]
+            has_length = False
+            for k, v in response["headers"]:
+                if k.lower() == b"content-length":
+                    has_length = True
+                head.append(k + b": " + v)
+            if not has_length:
+                head.append(b"content-length: " + str(len(response["body"])).encode())
+            head.append(b"connection: keep-alive")
+            writer.write(b"\r\n".join(head) + b"\r\n\r\n" + response["body"])
+            await writer.drain()
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        pass
+    finally:
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+async def serve(app, host: str = "0.0.0.0", port: int = 8000,
+                ready_event: asyncio.Event | None = None):
+    await app.router.startup()
+    server = await asyncio.start_server(
+        lambda r, w: _handle_connection(app, r, w), host, port)
+    logger.info("httpd listening on %s:%d", host, port)
+    if ready_event is not None:
+        ready_event.set()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    async with server:
+        await stop.wait()
+    await app.router.shutdown()
+
+
+def run(app, host: str = "0.0.0.0", port: int = 8000):
+    asyncio.run(serve(app, host, port))

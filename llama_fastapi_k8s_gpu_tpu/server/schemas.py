@@ -1,0 +1,29 @@
+"""Request/response schemas — field-for-field the reference's
+``data/requests.py:4-19`` so existing clients keep working unchanged."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pydantic import BaseModel
+
+
+class ChatMessage(BaseModel):
+    turn: str
+    message: str
+
+
+class BotProfile(BaseModel):
+    name: str
+    appearance: str
+    system_prompt: Optional[str] = ""
+
+
+class UserProfile(BaseModel):
+    name: str
+
+
+class BotMessageRequest(BaseModel):
+    bot_profile: BotProfile
+    user_profile: UserProfile
+    context: list[ChatMessage]

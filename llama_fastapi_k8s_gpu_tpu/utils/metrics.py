@@ -1,0 +1,56 @@
+"""Minimal Prometheus-text metrics registry.
+
+The reference README advertises "metrics, alerts" (reference README.md:9) with
+no implementation (SURVEY.md §5 "Metrics"); this makes the claim true: queue
+depth, request counters, and latency/TTFT summaries exposed at ``/metrics``.
+No external client library — the text exposition format is trivial.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        # name -> (sum, count, min, max)
+        self._summaries: dict[str, list[float]] = {}
+
+    def inc(self, name: str, value: float = 1.0):
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float):
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float):
+        with self._lock:
+            s = self._summaries.setdefault(name, [0.0, 0.0, float("inf"), float("-inf")])
+            s[0] += value
+            s[1] += 1
+            s[2] = min(s[2], value)
+            s[3] = max(s[3], value)
+
+    def render(self) -> str:
+        lines = []
+        with self._lock:
+            for name, v in sorted(self._counters.items()):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {v}")
+            for name, v in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {v}")
+            for name, (total, count, mn, mx) in sorted(self._summaries.items()):
+                lines.append(f"# TYPE {name} summary")
+                lines.append(f"{name}_sum {total}")
+                lines.append(f"{name}_count {count}")
+                if count:
+                    lines.append(f"{name}_min {mn}")
+                    lines.append(f"{name}_max {mx}")
+                    lines.append(f"{name}_avg {total / count}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,190 @@
+"""Server integration tests against fake engines (SURVEY.md §4
+"Integration"): exercises the queue/semaphore/timeout/503/408/500 admission
+paths deterministically, plus the prompt-assembly and truncation quirks that
+must match reference api.py."""
+
+import asyncio
+
+import httpx
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.engine import FakeEngine
+from llama_fastapi_k8s_gpu_tpu.server.app import (
+    build_system_prompt,
+    count_tokens_roughly,
+    create_app,
+    truncate_messages_to_fit_context,
+)
+from llama_fastapi_k8s_gpu_tpu.server.schemas import BotProfile
+from llama_fastapi_k8s_gpu_tpu.utils.config import Settings
+
+BODY = {
+    "bot_profile": {"name": "Alice.f", "appearance": "tall,slim,blonde,loves cats,hates rain"},
+    "user_profile": {"name": "Bob"},
+    "context": [
+        {"turn": "user", "message": "hi"},
+        {"turn": "assistant", "message": "hey"},
+        {"turn": "user", "message": "how are you?"},
+    ],
+}
+
+
+def make_client(engine, **settings_kw):
+    settings = Settings(**settings_kw) if settings_kw else Settings()
+    app = create_app(engine=engine, settings=settings)
+    transport = httpx.ASGITransport(app=app)
+    return app, transport
+
+
+async def lifespan_client(app, transport):
+    return httpx.AsyncClient(transport=transport, base_url="http://test")
+
+
+@pytest.mark.anyio
+async def test_response_happy_path():
+    engine = FakeEngine(reply="hello there")
+    app, transport = make_client(engine)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            r = await client.post("/response", json=BODY)
+            assert r.status_code == 200
+            assert r.json() == {"response": "hello there"}
+        await app.router.shutdown()
+
+    # prompt assembly: system inserted at index 1 (not 0!)
+    sent = engine.calls[0]
+    assert sent[0] == {"role": "user", "content": "hi"}
+    assert sent[1]["role"] == "system"
+    sys_prompt = sent[1]["content"]
+    # .f suffix → girl clause; appearance facts after 3rd comma appended
+    assert "You a girl." in sys_prompt
+    assert "loves cats" in sys_prompt and "hates rain" in sys_prompt
+    assert "tall" not in sys_prompt  # first three appearance fields dropped
+    assert "Alice.f" in sys_prompt  # name interpolated into default persona
+
+
+@pytest.mark.anyio
+async def test_explicit_system_prompt_wins():
+    engine = FakeEngine()
+    body = {**BODY, "bot_profile": {**BODY["bot_profile"],
+                                    "system_prompt": "custom prompt",
+                                    "name": "Carol"}}
+    app, transport = make_client(engine)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            r = await client.post("/response", json=body)
+            assert r.status_code == 200
+        await app.router.shutdown()
+    sys_prompt = engine.calls[0][1]["content"]
+    assert sys_prompt.startswith("custom prompt")
+    assert "You a boy." in sys_prompt  # no .f suffix
+
+
+@pytest.mark.anyio
+async def test_queue_full_503():
+    engine = FakeEngine(delay=0.5)
+    app, transport = make_client(engine, max_queue_size=1, timeout_seconds=5)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            tasks = [asyncio.create_task(client.post("/response", json=BODY))
+                     for _ in range(4)]
+            results = await asyncio.gather(*tasks)
+            codes = sorted(r.status_code for r in results)
+            assert 503 in codes  # overflow rejected
+            assert 200 in codes  # some served
+        await app.router.shutdown()
+
+
+@pytest.mark.anyio
+async def test_timeout_408_and_cancellation():
+    engine = FakeEngine(delay=1.0)
+    app, transport = make_client(engine, timeout_seconds=0.1)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            r = await client.post("/response", json=BODY)
+            assert r.status_code == 408
+            assert r.json()["detail"] == "Generation timed out"
+        await app.router.shutdown()
+
+
+@pytest.mark.anyio
+async def test_engine_error_500():
+    engine = FakeEngine(fail=RuntimeError("boom"))
+    app, transport = make_client(engine)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            r = await client.post("/response", json=BODY)
+            assert r.status_code == 500
+            assert "boom" in r.json()["detail"]
+        await app.router.shutdown()
+
+
+@pytest.mark.anyio
+async def test_health_and_metrics_and_items():
+    engine = FakeEngine()
+    app, transport = make_client(engine)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            h = await client.get("/health")
+            assert h.status_code == 200
+            assert h.json()["status"] == "ok"
+            assert h.json()["model_loaded"] is True
+
+            await client.post("/response", json=BODY)
+            m = await client.get("/metrics")
+            assert m.status_code == 200
+            assert "request_seconds_count" in m.text
+            assert "queue_depth" in m.text
+
+            i = await client.get("/items/7")
+            assert i.json() == {"item_id": 7}
+        await app.router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pure-function behavior parity (reference api.py:30-46, 127-147)
+# ---------------------------------------------------------------------------
+
+def test_count_tokens_roughly():
+    assert count_tokens_roughly("abcd" * 10) == 10
+    assert count_tokens_roughly("abc") == 0
+
+
+def test_truncation_clips_and_pops_index_2():
+    messages = [
+        {"role": "user", "content": "a" * 500},      # index 0 preserved
+        {"role": "system", "content": "s" * 450},    # index 1 preserved
+        {"role": "user", "content": "b" * 400},      # evicted first
+        {"role": "assistant", "content": "c" * 400},
+        {"role": "user", "content": "d" * 400},
+    ]
+    out = truncate_messages_to_fit_context(messages, max_tokens=300)
+    # every message clipped to 400 chars
+    assert len(out[0]["content"]) == 400
+    # index-2 eviction until under budget, first two pinned
+    assert out[0]["content"][0] == "a"
+    assert out[1]["content"][0] == "s"
+    total = sum(count_tokens_roughly(m["content"]) for m in out)
+    assert total <= 300 or len(out) == 2
+
+
+def test_truncation_mutates_in_place():
+    # quirk preserved from api.py:37-39: caller's list/dicts are mutated
+    messages = [{"role": "user", "content": "x" * 500}]
+    truncate_messages_to_fit_context(messages, 1000)
+    assert len(messages[0]["content"]) == 400
+
+
+def test_gender_clause_and_appearance():
+    p = BotProfile(name="Zoe.f", appearance="a,b,c,d,e")
+    sp = build_system_prompt(p)
+    assert sp.endswith("de") and "You a girl." in sp
+    p2 = BotProfile(name="Max", appearance="a,b,c")
+    sp2 = build_system_prompt(p2)
+    assert "You a boy." in sp2 and sp2.endswith("You a boy.")
