@@ -1,0 +1,158 @@
+"""Benchmark: Llama-3-8B decode throughput + prefill TTFT on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+The reference's engine (llama.cpp cuBLAS, reference docker/Dockerfile.base:30)
+publishes no numbers; the driver-provided target (BASELINE.md) is A10G-parity
+decode throughput for Llama-3-8B Q4_K_M — llama.cpp-class engines decode
+Q4_K_M 8B on an A10G at roughly 30-60 tok/s; vs_baseline is computed against
+the 45 tok/s midpoint.
+
+The model is the real 8B architecture (models/config.py LLAMA3_8B) with
+synthesized int8 weights (zero-egress environment: weights cannot be
+downloaded, and decode speed is value-independent — it is bound by HBM
+bytes/token, which synthetic weights reproduce exactly).
+
+Run standalone and ALONE (the device tunnel is single-session):
+    python bench.py            # real chip, 8B
+    LFKT_BENCH_PRESET=tiny JAX_PLATFORMS=cpu python bench.py   # smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B, ModelConfig  # noqa: E402
+from llama_fastapi_k8s_gpu_tpu.models.generate import (  # noqa: E402
+    generate_chunk_jit,
+    init_state,
+    prefill_jit,
+    sample_jit,
+)
+from llama_fastapi_k8s_gpu_tpu.sampling.sample import (  # noqa: E402
+    SamplingParams,
+    sampling_tensors,
+    seed_window,
+)
+
+A10G_Q4KM_8B_TOK_S = 45.0  # midpoint of the 30-60 tok/s llama.cpp A10G range
+
+TINY = ModelConfig(vocab_size=512, dim=128, n_layers=2, n_heads=8,
+                   n_kv_heads=4, ffn_dim=256, n_ctx=256)
+
+
+def synth_int8_device(cfg: ModelConfig, seed: int = 0) -> dict:
+    """Device-side random int8 params (no multi-GB host RNG / transfer)."""
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    L = cfg.n_layers
+    key = jax.random.PRNGKey(seed)
+
+    def lin(k, out_dim, in_dim):
+        q = jax.random.randint(k, (L, out_dim, in_dim), -127, 128, jnp.int8)
+        s = jnp.full((L, out_dim), (in_dim ** -0.5) / 127.0, jnp.float32)
+        return {"q": q, "s": s}
+
+    ks = jax.random.split(key, 8)
+    emb = (jax.random.normal(ks[0], (cfg.vocab_size, cfg.dim), jnp.bfloat16)
+           * (cfg.dim ** -0.5))
+    return {
+        "tok_emb": emb,
+        "layers": {
+            "attn_norm": jnp.ones((L, cfg.dim), jnp.float32),
+            "wq": lin(ks[1], cfg.dim, cfg.dim),
+            "wk": lin(ks[2], kv_dim, cfg.dim),
+            "wv": lin(ks[3], kv_dim, cfg.dim),
+            "wo": lin(ks[4], cfg.dim, cfg.dim),
+            "ffn_norm": jnp.ones((L, cfg.dim), jnp.float32),
+            "w_gate": lin(ks[5], cfg.ffn_dim, cfg.dim),
+            "w_up": lin(ks[6], cfg.ffn_dim, cfg.dim),
+            "w_down": lin(ks[7], cfg.dim, cfg.ffn_dim),
+        },
+        "out_norm": jnp.ones(cfg.dim, jnp.float32),
+        "output": {
+            "q": jax.random.randint(ks[0], (cfg.vocab_size, cfg.dim), -127, 128, jnp.int8),
+            "s": jnp.full((cfg.vocab_size,), (cfg.dim ** -0.5) / 127.0, jnp.float32),
+        },
+    }
+
+
+def main():
+    preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
+    cfg = TINY if preset == "tiny" else LLAMA3_8B
+    prompt_len = 128
+    gen_tokens = int(os.environ.get("LFKT_BENCH_TOKENS", "256" if preset != "tiny" else "32"))
+    chunk = int(os.environ.get("LFKT_BENCH_CHUNK", "16"))
+
+    dev = jax.devices()[0]
+    t0 = time.time()
+    params = synth_int8_device(cfg)
+    jax.block_until_ready(params)
+    load_s = time.time() - t0
+
+    sp = SamplingParams()
+    st = sampling_tensors(sp)
+    prompt = list(range(1, prompt_len + 1))
+    tokens = jnp.asarray(prompt, jnp.int32)
+
+    def one_request(state):
+        logits, cache = prefill_jit(params, cfg, tokens, jnp.int32(prompt_len),
+                                    state["cache"])
+        window, wpos = seed_window(prompt)
+        tok, window, wpos, key = sample_jit(logits, window, wpos,
+                                            jax.random.PRNGKey(0), st, cfg)
+        jax.block_until_ready(tok)
+        return {
+            "cache": cache, "pos": jnp.int32(prompt_len), "token": tok,
+            "window": window, "wpos": wpos, "key": key,
+        }
+
+    # warmup: compile prefill + decode-chunk
+    state = one_request(init_state(cfg))
+    state, _ = generate_chunk_jit(params, cfg, state, st, n_steps=chunk)
+    jax.block_until_ready(state["pos"])
+    compile_s = time.time() - t0 - load_s
+
+    # TTFT: prompt → first sampled token (steady-state, median of 5)
+    ttfts = []
+    for _ in range(5):
+        t1 = time.time()
+        state = one_request(state)
+        ttfts.append(time.time() - t1)
+    ttft_ms = sorted(ttfts)[len(ttfts) // 2] * 1000
+
+    # decode throughput: gen_tokens steady-state tokens
+    state = one_request(state)
+    n_chunks = max(1, gen_tokens // chunk)
+    t2 = time.time()
+    for _ in range(n_chunks):
+        state, toks = generate_chunk_jit(params, cfg, state, st, n_steps=chunk)
+    jax.block_until_ready(toks)
+    decode_s = time.time() - t2
+    tok_s = (n_chunks * chunk) / decode_s
+
+    result = {
+        "metric": f"decode_tokens_per_sec_per_chip[{preset},int8,synthetic]",
+        "value": round(tok_s, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tok_s / A10G_Q4KM_8B_TOK_S, 3),
+        "ttft_ms_p50": round(ttft_ms, 1),
+        "prompt_tokens": prompt_len,
+        "gen_tokens": n_chunks * chunk,
+        "decode_chunk": chunk,
+        "device": str(dev),
+        "load_s": round(load_s, 1),
+        "compile_s": round(compile_s, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

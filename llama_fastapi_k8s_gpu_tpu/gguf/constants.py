@@ -99,6 +99,10 @@ GGML_BLOCK_SIZES: dict[GGMLType, tuple[int, int]] = {
 }
 
 
+def align_up(n: int, alignment: int) -> int:
+    return (n + alignment - 1) // alignment * alignment
+
+
 def tensor_nbytes(ggml_type: GGMLType, n_elements: int) -> int:
     block, nbytes = GGML_BLOCK_SIZES[ggml_type]
     if n_elements % block != 0:

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import struct
-from typing import Any, BinaryIO
+from typing import Any
 
 import numpy as np
 
@@ -21,6 +21,7 @@ from .constants import (
     GGUF_SCALAR_FMT as _SCALAR_FMT,
     GGMLType,
     GGUFValueType,
+    align_up,
     tensor_nbytes,
 )
 
@@ -158,7 +159,7 @@ class GGUFFile:
             self.tensors[name] = GGUFTensor(name, shape, ggml_type, offset, self)
 
         self.alignment = int(self.metadata.get("general.alignment", GGUF_DEFAULT_ALIGNMENT))
-        self.data_offset = (cur.off + self.alignment - 1) // self.alignment * self.alignment
+        self.data_offset = align_up(cur.off, self.alignment)
 
     @property
     def architecture(self) -> str:

@@ -10,7 +10,7 @@ egress.
 from __future__ import annotations
 
 import struct
-from typing import Any, BinaryIO, Iterable
+from typing import Any
 
 import numpy as np
 
@@ -21,6 +21,7 @@ from .constants import (
     GGUF_VERSION,
     GGMLType,
     GGUFValueType,
+    align_up,
     tensor_nbytes,
 )
 from . import quants
@@ -139,11 +140,9 @@ class GGUFWriter:
                 for d in shape:
                     f.write(struct.pack("<Q", d))
                 f.write(struct.pack("<IQ", int(ggml_type), offset))
-                offset += (raw.nbytes + self.alignment - 1) // self.alignment * self.alignment
+                offset += align_up(raw.nbytes, self.alignment)
             pos = f.tell()
-            pad = (pos + self.alignment - 1) // self.alignment * self.alignment - pos
-            f.write(b"\x00" * pad)
+            f.write(b"\x00" * (align_up(pos, self.alignment) - pos))
             for _, _, _, raw in self._tensors:
                 f.write(raw.tobytes())
-                pad = (raw.nbytes + self.alignment - 1) // self.alignment * self.alignment - raw.nbytes
-                f.write(b"\x00" * pad)
+                f.write(b"\x00" * (align_up(raw.nbytes, self.alignment) - raw.nbytes))

@@ -1,0 +1,69 @@
+"""Batched (data-parallel) prefill/decode over a device mesh.
+
+``vmap`` lifts the single-sequence model (models/llama.py) over a batch axis;
+NamedShardings place the batch on the ``dp`` mesh axis and the model on
+``tp``, so one jit'd program serves B concurrent sequences across the mesh —
+the TPU-native replacement for the reference's "4 independent single-GPU
+pods" data parallelism (SURVEY.md §2A), and the basis of the v5e-4
+"concurrent /response load" config in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..models.llama import forward, init_cache, prefill
+from ..sampling.sample import PENALTY_WINDOW, sample_chain
+
+
+def init_batched_state(cfg: ModelConfig, batch: int, seed: int = 0) -> dict:
+    cache = init_cache(cfg)
+    return {
+        "cache": jax.tree.map(lambda x: jnp.broadcast_to(x, (batch,) + x.shape), cache),
+        "pos": jnp.zeros(batch, jnp.int32),
+        "token": jnp.zeros(batch, jnp.int32),
+        "window": jnp.full((batch, PENALTY_WINDOW), -1, jnp.int32),
+        "wpos": jnp.zeros(batch, jnp.int32),
+        "key": jax.random.split(jax.random.PRNGKey(seed), batch),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("caches",))
+def batched_prefill_jit(params, cfg: ModelConfig, tokens, lengths, caches):
+    """tokens (B, S) padded; lengths (B,). Returns (logits (B, V), caches)."""
+    return jax.vmap(
+        lambda t, l, c: prefill(params, cfg, t, l, c)
+    )(tokens, lengths, caches)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "top_k"),
+    donate_argnames=("state",),
+)
+def batched_generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
+                               n_steps: int, top_k: int = 40):
+    """B sequences × n_steps decode+sample steps on device.
+    Returns (state, tokens (n_steps, B))."""
+
+    def one_step(carry, _):
+        def single(token, pos, cache, window, wpos, key):
+            logits, cache = forward(params, cfg, token[None], pos, cache)
+            key, sub = jax.random.split(key)
+            tok = sample_chain(logits, window, sub, st, top_k=top_k)
+            window = window.at[wpos % PENALTY_WINDOW].set(tok)
+            return tok, pos + 1, cache, window, wpos + 1, key
+
+        tok, pos, cache, window, wpos, key = jax.vmap(single)(
+            carry["token"], carry["pos"], carry["cache"],
+            carry["window"], carry["wpos"], carry["key"],
+        )
+        new_carry = {"cache": cache, "pos": pos, "token": tok,
+                     "window": window, "wpos": wpos, "key": key}
+        return new_carry, tok
+
+    return jax.lax.scan(one_step, state, None, length=n_steps)

@@ -1,0 +1,121 @@
+"""Device meshes and sharding rules.
+
+The reference has no distributed backend at all — its only parallelism is
+k8s-replica data parallelism behind a Service (SURVEY.md §2A "Parallelism
+strategies").  On TPU the equivalent *and more* is declarative: build a
+``jax.sharding.Mesh`` over the chips, annotate the param/cache pytrees with
+``NamedSharding``s, and XLA inserts the collectives (all-gather /
+psum / reduce-scatter) over ICI.  There is no NCCL analogue to wrap —
+declaring shardings IS the communication backend on TPU (SURVEY.md §5
+"Distributed communication backend").
+
+Axes:
+- ``dp`` — data parallel over concurrent requests (batch dim).
+- ``tp`` — tensor parallel (Megatron-style): attention heads and FFN hidden
+  sharded column-wise, output projections row-wise (psum on exit),
+  KV cache sharded over kv-heads, LM head sharded over vocab.
+
+The same rules drive the v5e-4 serving config and the virtual 8-device CPU
+mesh used by tests and the driver's multi-chip dryrun.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+
+def make_mesh(dp: int = 1, tp: int = 1, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = dp * tp
+    if n > len(devices):
+        raise ValueError(f"mesh {dp}x{tp} needs {n} devices, have {len(devices)}")
+    mesh_devices = mesh_utils.create_device_mesh((dp, tp), devices=devices[:n])
+    return Mesh(mesh_devices, axis_names=("dp", "tp"))
+
+
+def _ns(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def _linear_sharding(mesh: Mesh, col_parallel: bool) -> dict:
+    """Sharding for a stacked linear {'w': (L,out,in)} or {'q','s'}.
+
+    Column-parallel (wq/wk/wv/w_gate/w_up): shard the output dim.
+    Row-parallel (wo/w_down): shard the input dim; XLA inserts the psum.
+    """
+    if col_parallel:
+        return {"w": _ns(mesh, None, "tp", None),
+                "q": _ns(mesh, None, "tp", None),
+                "s": _ns(mesh, None, "tp")}
+    return {"w": _ns(mesh, None, None, "tp"),
+            "q": _ns(mesh, None, None, "tp"),
+            "s": _ns(mesh, None, None)}
+
+
+def _match_linear(shardings: dict, linear: dict) -> dict:
+    return {k: shardings[k] for k in linear}
+
+
+def param_shardings(params: dict, mesh: Mesh) -> dict:
+    """NamedSharding pytree matching a param pytree from models.params."""
+    col = _linear_sharding(mesh, True)
+    row = _linear_sharding(mesh, False)
+    layers = params["layers"]
+    layer_shard = {}
+    for name, leaf in layers.items():
+        if name in ("attn_norm", "ffn_norm"):
+            layer_shard[name] = _ns(mesh, None, None)
+        elif name in ("wq", "wk", "wv", "w_gate", "w_up"):
+            layer_shard[name] = _match_linear(col, leaf)
+        else:  # wo, w_down
+            layer_shard[name] = _match_linear(row, leaf)
+    out = params["output"]
+    out_shard = {k: (_ns(mesh, "tp", None) if k in ("w", "q") else _ns(mesh, "tp"))
+                 for k in out}
+    return {
+        "tok_emb": _ns(mesh, None, None),      # replicated (gather-heavy)
+        "layers": layer_shard,
+        "out_norm": _ns(mesh, None),
+        "output": out_shard,
+    }
+
+
+def cache_shardings(cfg: ModelConfig, mesh: Mesh, batched: bool = False):
+    """KV cache (L, ctx, n_kv, hd): kv-heads over tp; batch (if any) over dp."""
+    if batched:
+        s = _ns(mesh, "dp", None, None, "tp", None)
+    else:
+        s = _ns(mesh, None, None, "tp", None)
+    return {"k": s, "v": s}
+
+
+def state_shardings(cfg: ModelConfig, mesh: Mesh, batched: bool = False) -> dict:
+    """Shardings for the generation-state pytree (models.generate.init_state)."""
+    if batched:
+        scalar = _ns(mesh, "dp")
+        vec = _ns(mesh, "dp", None)
+    else:
+        scalar = _ns(mesh)
+        vec = _ns(mesh, None)
+    return {
+        "cache": cache_shardings(cfg, mesh, batched),
+        "pos": scalar,
+        "token": scalar,
+        "window": vec,
+        "wpos": scalar,
+        "key": vec,
+    }
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    return jax.device_put(params, param_shardings(params, mesh))
+
+
+def shard_cache(cache: dict, cfg: ModelConfig, mesh: Mesh, batched: bool = False) -> dict:
+    return jax.device_put(cache, cache_shardings(cfg, mesh, batched))

@@ -48,7 +48,6 @@ class Settings:
     decode_chunk: int = 8           # device-side tokens per host round-trip
     prefill_buckets: str = "128,256,512,1024"  # padded prompt shapes to bound recompiles
     weight_format: str = "auto"     # auto | bf16 | int8 | q4k
-    host_platform: str = ""         # force JAX_PLATFORMS for tests ("cpu")
 
     @property
     def model_path(self) -> str:
@@ -77,5 +76,4 @@ def get_settings() -> Settings:
         decode_chunk=_env("LFKT_DECODE_CHUNK", Settings.decode_chunk, int),
         prefill_buckets=_env("LFKT_PREFILL_BUCKETS", Settings.prefill_buckets),
         weight_format=_env("LFKT_WEIGHT_FORMAT", Settings.weight_format),
-        host_platform=_env("LFKT_HOST_PLATFORM", Settings.host_platform),
     )

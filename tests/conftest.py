@@ -1,17 +1,30 @@
 """Test harness config.
 
-Force JAX onto the XLA-CPU backend with 8 virtual devices BEFORE jax is
-imported anywhere, so model/sharding tests run without TPU hardware
-(SURVEY.md §4 "Device tests"). Multi-chip logic is exercised on the virtual
-device mesh exactly as the driver's dryrun does.
+Force JAX onto the XLA-CPU backend with 8 virtual devices so model/sharding
+tests run without TPU hardware (SURVEY.md §4 "Device tests").  Two layers of
+defense, because a site hook may pre-register an accelerator platform and
+override JAX_PLATFORMS at interpreter startup:
+
+1. env vars (effective when pytest is launched in a clean environment);
+2. a post-import ``jax.config.update("jax_platforms", "cpu")``, which wins as
+   long as no backend has been initialized yet — keeping the entire test
+   session off any shared single-session device tunnel (tests must never
+   contend with a concurrently running bench/serving process for the chip).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", jax.devices()
+assert len(jax.devices()) == 8, jax.devices()
