@@ -17,6 +17,7 @@ streaming chunks (SURVEY.md §2B) — on a JAX/TPU runtime:
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -52,6 +53,7 @@ class Engine:
         prefill_buckets: Sequence[int] = DEFAULT_BUCKETS,
         max_gen_tokens: int = 512,
         seed: int = 0,
+        attn_impl: str = "auto",  # auto | xla | pallas (prefill flash kernel)
         *,
         _parts: tuple | None = None,  # (params, cfg, tokenizer, template_kind)
     ):
@@ -88,6 +90,18 @@ class Engine:
                 model_path, gf.architecture, self.cfg.n_layers, weight_format,
                 time.time() - t0,
             )
+        if attn_impl == "auto":
+            # the flash kernel wants lane-aligned heads; anything else (tiny
+            # test models, CPU runs) stays on the XLA score-matrix path
+            attn_impl = (
+                "pallas"
+                if jax.default_backend() == "tpu" and self.cfg.head_dim % 128 == 0
+                else "xla"
+            )
+        if attn_impl not in ("xla", "pallas"):
+            raise ValueError(f"attn_impl must be auto|xla|pallas, got {attn_impl!r}")
+        if attn_impl != self.cfg.attn_impl:
+            self.cfg = dataclasses.replace(self.cfg, attn_impl=attn_impl)
         self.prefill_buckets = sorted(b for b in prefill_buckets if b <= self.cfg.n_ctx)
         if not self.prefill_buckets or self.prefill_buckets[-1] < self.cfg.n_ctx:
             self.prefill_buckets.append(self.cfg.n_ctx)

@@ -28,6 +28,9 @@ class ModelConfig:
     sliding_window: int = 0      # 0 = full causal attention
     tie_embeddings: bool = False
     dtype: str = "bfloat16"
+    # "xla" materializes (S, n_ctx) scores; "pallas" streams K/V through the
+    # blockwise flash kernel (ops/pallas/attention.py) on prefill paths.
+    attn_impl: str = "xla"
 
     @property
     def head_dim(self) -> int:

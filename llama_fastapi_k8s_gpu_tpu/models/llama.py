@@ -69,23 +69,34 @@ def _layer(h, lp, ck, cv, positions, pos_offset, cfg: ModelConfig):
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (pos_offset, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (pos_offset, 0, 0))
 
-    # (S, n_kv, group, hd) → (n_kv, group, S, hd)
-    qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
-    kk = ck.transpose(1, 0, 2)  # (n_kv, n_ctx, hd)
-    vv = cv.transpose(1, 0, 2)
-    scores = jnp.einsum(
-        "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
-    ) * (hd ** -0.5)  # (n_kv, group, S, n_ctx)
+    if cfg.attn_impl == "pallas" and S > 1:
+        # blockwise flash kernel: streams K/V, never materializes scores
+        from ..ops.pallas import flash_attention, use_interpret
 
-    key_pos = jnp.arange(cfg.n_ctx)
-    q_pos = positions  # (S,)
-    mask = key_pos[None, :] <= q_pos[:, None]  # causal over the whole ring
-    if cfg.sliding_window:
-        mask &= key_pos[None, :] > q_pos[:, None] - cfg.sliding_window
-    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
-    ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv)  # (n_kv, group, S, hd)
-    ctx = ctx.transpose(2, 0, 1, 3).reshape(S, cfg.n_heads * hd).astype(h.dtype)
+        ctx = flash_attention(
+            q, ck, cv, pos_offset,
+            sm_scale=hd ** -0.5,
+            sliding_window=cfg.sliding_window,
+            interpret=use_interpret(),
+        ).reshape(S, cfg.n_heads * hd).astype(h.dtype)
+    else:
+        # (S, n_kv, group, hd) → (n_kv, group, S, hd)
+        qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
+        kk = ck.transpose(1, 0, 2)  # (n_kv, n_ctx, hd)
+        vv = cv.transpose(1, 0, 2)
+        scores = jnp.einsum(
+            "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
+        ) * (hd ** -0.5)  # (n_kv, group, S, n_ctx)
+
+        key_pos = jnp.arange(cfg.n_ctx)
+        q_pos = positions  # (S,)
+        mask = key_pos[None, :] <= q_pos[:, None]  # causal over the whole ring
+        if cfg.sliding_window:
+            mask &= key_pos[None, :] > q_pos[:, None] - cfg.sliding_window
+        scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
+        ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv)  # (n_kv, group, S, hd)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(S, cfg.n_heads * hd).astype(h.dtype)
     h = h + linear(ctx, lp["wo"])
 
     hn = rms_norm(h, lp["ffn_norm"], cfg.rms_eps)

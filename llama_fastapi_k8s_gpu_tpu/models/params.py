@@ -22,16 +22,27 @@ from __future__ import annotations
 
 import logging
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..gguf import GGUFFile
-from ..ops import make_linear_bf16, make_linear_int8
+from ..ops import make_linear_bf16, make_linear_int8, make_linear_int8_device
 from .config import ModelConfig
 
 logger = logging.getLogger(__name__)
 
 _LINEAR_MAKERS = {"bf16": make_linear_bf16, "int8": make_linear_int8}
+
+
+def _tensor_to_device(t, dtype=jnp.float32) -> jax.Array:
+    """Raw GGUF bytes → dequantized device array via the Pallas kernels
+    (ops/pallas/dequant.py): the host ships quantized bytes, the chip
+    expands them."""
+    from ..ops.pallas import device_dequant
+
+    flat = device_dequant(t.raw(), t.ggml_type, t.n_elements, dtype)
+    return flat.reshape(tuple(reversed(t.shape)))
 
 
 def _stack(dicts: list[dict]) -> dict:
@@ -46,11 +57,24 @@ def _stack(dicts: list[dict]) -> dict:
     return out
 
 
-def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16") -> dict:
-    """Dequantize all tensors from ``gf`` into a stacked param pytree."""
+def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
+                on_device: bool | None = None) -> dict:
+    """Dequantize all tensors from ``gf`` into a stacked param pytree.
+
+    ``on_device=True`` (default on TPU) routes quantized tensors through the
+    Pallas dequant kernels and requantizes int8 on device; ``False`` uses
+    the numpy reference codecs.  Both produce identical pytrees.
+    """
+    if on_device is None:
+        on_device = jax.default_backend() == "tpu"
     make = _LINEAR_MAKERS[fmt]
 
     def lin(name: str) -> dict:
+        if on_device:
+            w = _tensor_to_device(gf[name])
+            if fmt == "int8":
+                return make_linear_int8_device(w)
+            return {"w": w.astype(jnp.bfloat16)}
         return make(gf[name].astype_f32())
 
     def norm(name: str):
@@ -72,11 +96,14 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16") -> dict:
         })
         logger.debug("loaded layer %d/%d", i + 1, cfg.n_layers)
 
-    emb = jnp.asarray(gf["token_embd.weight"].astype_f32(), dtype=jnp.bfloat16)
+    if on_device:
+        emb = _tensor_to_device(gf["token_embd.weight"], jnp.bfloat16)
+    else:
+        emb = jnp.asarray(gf["token_embd.weight"].astype_f32(), dtype=jnp.bfloat16)
     if cfg.tie_embeddings or "output.weight" not in gf.tensors:
         output = {"w": emb}
     else:
-        output = make(gf["output.weight"].astype_f32())
+        output = lin("output.weight")
     return {
         "tok_emb": emb,
         "layers": _stack(layers),

@@ -1,1 +1,6 @@
-from .linear import linear, make_linear_bf16, make_linear_int8  # noqa: F401
+from .linear import (  # noqa: F401
+    linear,
+    make_linear_bf16,
+    make_linear_int8,
+    make_linear_int8_device,
+)

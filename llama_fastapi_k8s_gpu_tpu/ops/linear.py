@@ -36,6 +36,17 @@ def make_linear_int8(w: np.ndarray) -> dict:
     return {"q": jnp.asarray(q), "s": jnp.asarray(scale)}
 
 
+@jax.jit
+def make_linear_int8_device(w: jax.Array) -> dict:
+    """:func:`make_linear_int8` on device — used by the Pallas load path so
+    requantization never round-trips through the host."""
+    w = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale[:, None]), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
 def linear(x: jax.Array, w: dict) -> jax.Array:
     """x: (..., in) bf16 → (..., out) bf16."""
     if "w" in w:

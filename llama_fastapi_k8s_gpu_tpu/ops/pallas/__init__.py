@@ -1,0 +1,56 @@
+"""Pallas TPU kernels — the in-tree analogue of the CUDA kernels the
+reference consumes through llama.cpp's cuBLAS build (reference
+docker/Dockerfile.base:30-32).
+
+Two kernel families:
+
+- :mod:`.attention` — blockwise flash attention (online softmax) for the
+  prefill hot path, causal + optional sliding window, GQA-aware.
+- :mod:`.dequant` — K-quant dequantization (Q4_K / Q5_K / Q6_K / Q8_0)
+  executed *on device*: the host uploads the raw quantized block bytes
+  (≈4.5 bit/weight) and the TPU expands them to bf16/f32 in HBM, so the
+  host→device transfer is the quantized size, not the dequantized size.
+
+Every kernel runs in interpret mode off-TPU so the whole suite is testable
+on the CPU backend (SURVEY.md §4 "Device tests").
+"""
+
+from __future__ import annotations
+
+import jax
+
+_FORCE_INTERPRET: bool | None = None
+
+
+def force_interpret(value: bool | None) -> None:
+    """Override interpret-mode detection (None = auto by backend)."""
+    global _FORCE_INTERPRET
+    _FORCE_INTERPRET = value
+
+
+def use_interpret() -> bool:
+    """Pallas kernels compile natively only on TPU; interpret elsewhere."""
+    if _FORCE_INTERPRET is not None:
+        return _FORCE_INTERPRET
+    return jax.default_backend() != "tpu"
+
+
+from .attention import flash_attention  # noqa: E402
+from .dequant import (  # noqa: E402
+    device_dequant,
+    dequant_q4_k_device,
+    dequant_q5_k_device,
+    dequant_q6_k_device,
+    dequant_q8_0_device,
+)
+
+__all__ = [
+    "flash_attention",
+    "device_dequant",
+    "dequant_q4_k_device",
+    "dequant_q5_k_device",
+    "dequant_q6_k_device",
+    "dequant_q8_0_device",
+    "force_interpret",
+    "use_interpret",
+]

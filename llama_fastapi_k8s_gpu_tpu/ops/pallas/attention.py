@@ -1,0 +1,176 @@
+"""Blockwise flash attention for TPU (Pallas).
+
+The reference's attention runs inside llama.cpp's CUDA kernels (reference
+docker/Dockerfile.base:30-32); the XLA fallback in ``models/llama.py``
+materializes the full (S, n_ctx) score matrix.  This kernel streams K/V
+HBM→VMEM in blocks with an online softmax, so VMEM usage is O(block) and
+``n_ctx`` can grow past 1024 (SURVEY.md §5 "Long-context") without the
+scores ever hitting HBM.
+
+Layout: GQA folds the ``group = n_heads // n_kv_heads`` query heads that
+share one KV head into the row dimension, so each grid step is a dense
+(BQ, hd) × (hd, BK) MXU matmul.  The kv-block index is the *last* grid
+dimension — TPU grids execute sequentially, so the running max / sum /
+accumulator live in VMEM scratch across kv steps and the output is written
+once on the final step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Large-but-finite mask value: keeps exp() well-defined when an entire block
+# (or an entire padded row) is masked, unlike -inf.
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _attn_kernel(
+    # scalar prefetch
+    pos_ref,            # (1,) int32 — cache position of query token 0
+    # inputs
+    q_ref,              # (1, BQ, hd)
+    k_ref,              # (1, BK, hd)
+    v_ref,              # (1, BK, hd)
+    # outputs
+    o_ref,              # (1, BQ, hd)
+    # scratch
+    m_ref,              # (BQ, 128) f32  running max (lane-replicated)
+    l_ref,              # (BQ, 128) f32  running sum (lane-replicated)
+    acc_ref,            # (BQ, hd)  f32  running weighted sum
+    *,
+    seq_len: int,       # S — real (bucketed) query length
+    block_q: int,
+    block_k: int,
+    sm_scale: float,
+    sliding_window: int,
+):
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                                   # (BQ, hd)
+    k = k_ref[0]                                   # (BK, hd)
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale                                   # (BQ, BK)
+
+    # query cache positions: row r of this tile is query token (qb*BQ + r) % S
+    # (rows are (group, S)-flattened; all group copies share positions).
+    row = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    q_pos = pos_ref[0] + jax.lax.rem(row, seq_len)
+    key_pos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = key_pos <= q_pos
+    if sliding_window:
+        mask &= key_pos > q_pos - sliding_window
+    scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
+
+    m_prev = m_ref[:, :1]                          # (BQ, 1)
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(scores, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)                # rescale of old state
+    p = jnp.exp(scores - m_new)                    # (BQ, BK)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+    v = v_ref[0]                                   # (BK, hd)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)            # fully-masked (padded) rows
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _pick_block(n: int, preferred: int) -> int:
+    for b in (preferred, 512, 256, 128, 64, 32, 16, 8):
+        if b <= preferred and n % b == 0:
+            return b
+    return n
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "sliding_window", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,          # (S, n_heads, hd)
+    k: jax.Array,          # (n_ctx, n_kv_heads, hd) — full ring cache
+    v: jax.Array,          # (n_ctx, n_kv_heads, hd)
+    pos_offset: jax.Array, # scalar int32: cache position of q[0]
+    sm_scale: float,
+    sliding_window: int = 0,
+    block_q: int = 128,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal (+ sliding-window) attention of S queries over the KV ring.
+
+    Returns (S, n_heads, hd) in q.dtype.  The causal mask ``key_pos <=
+    q_pos`` makes unwritten cache slots invisible, exactly like the XLA
+    path in ``models/llama.py``.
+    """
+    S, n_heads, hd = q.shape
+    n_ctx, n_kv, _ = k.shape
+    group = n_heads // n_kv
+    gs = group * S
+
+    bq = _pick_block(gs, block_q)
+    bk = _pick_block(n_ctx, block_k)
+
+    # (S, n_kv, group, hd) → (n_kv, group*S, hd): row = g*S + s
+    qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3).reshape(n_kv, gs, hd)
+    kk = k.transpose(1, 0, 2)                      # (n_kv, n_ctx, hd)
+    vv = v.transpose(1, 0, 2)
+
+    grid = (n_kv, gs // bq, n_ctx // bk)
+    kernel = functools.partial(
+        _attn_kernel,
+        seq_len=S,
+        block_q=bq,
+        block_k=bk,
+        sm_scale=sm_scale,
+        sliding_window=sliding_window,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, hd), lambda h, qb, kb, *_: (h, qb, 0)),
+                pl.BlockSpec((1, bk, hd), lambda h, qb, kb, *_: (h, kb, 0)),
+                pl.BlockSpec((1, bk, hd), lambda h, qb, kb, *_: (h, kb, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bq, hd), lambda h, qb, kb, *_: (h, qb, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_kv, gs, hd), q.dtype),
+        interpret=interpret,
+    )(jnp.atleast_1d(pos_offset.astype(jnp.int32)), qg, kk, vv)
+
+    # (n_kv, group, S, hd) → (S, n_heads, hd)
+    return out.reshape(n_kv, group, S, hd).transpose(2, 0, 1, 3).reshape(S, n_heads, hd)

@@ -257,6 +257,7 @@ def _default_engine_factory(settings: Settings):
             decode_chunk=settings.decode_chunk,
             prefill_buckets=settings.prefill_bucket_list,
             max_gen_tokens=settings.max_gen_tokens,
+            attn_impl=settings.attn_impl,
         )
         eng.warmup()
         return eng

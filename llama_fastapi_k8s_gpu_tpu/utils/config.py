@@ -48,6 +48,7 @@ class Settings:
     decode_chunk: int = 8           # device-side tokens per host round-trip
     prefill_buckets: str = "128,256,512,1024"  # padded prompt shapes to bound recompiles
     weight_format: str = "auto"     # auto | bf16 | int8 | q4k
+    attn_impl: str = "auto"         # auto | xla | pallas (prefill flash kernel)
 
     @property
     def model_path(self) -> str:
@@ -76,4 +77,5 @@ def get_settings() -> Settings:
         decode_chunk=_env("LFKT_DECODE_CHUNK", Settings.decode_chunk, int),
         prefill_buckets=_env("LFKT_PREFILL_BUCKETS", Settings.prefill_buckets),
         weight_format=_env("LFKT_WEIGHT_FORMAT", Settings.weight_format),
+        attn_impl=_env("LFKT_ATTN_IMPL", Settings.attn_impl),
     )

@@ -1,0 +1,172 @@
+"""Pallas kernels vs their oracles (interpret mode on the CPU backend).
+
+- dequant kernels vs the numpy codecs in gguf/quants.py — bit-exact, since
+  both sides run the identical f32 arithmetic (SURVEY.md §4 "Unit").
+- flash attention vs the XLA score-matrix path in models/llama.py.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.gguf.constants import GGMLType
+from llama_fastapi_k8s_gpu_tpu.gguf.quants import dequantize, quantize
+from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+from llama_fastapi_k8s_gpu_tpu.models.generate import init_state, prefill_jit
+from llama_fastapi_k8s_gpu_tpu.models.params import synth_params
+from llama_fastapi_k8s_gpu_tpu.ops.pallas import device_dequant, flash_attention
+
+# ---------------------------------------------------------------------------
+# dequant
+# ---------------------------------------------------------------------------
+
+# counts chosen to exercise (kernel-only), (kernel+tail), and (tail-only)
+_COUNTS = {
+    GGMLType.Q8_0: [32 * 4 * 256 * 2, 32 * 4 * 256 + 32 * 20, 32 * 3],
+    GGMLType.Q4_K: [256 * 256 * 2, 256 * 256 + 256 * 7, 256 * 5],
+    GGMLType.Q5_K: [256 * 256 * 2, 256 * 256 + 256 * 7, 256 * 5],
+    GGMLType.Q6_K: [256 * 128 * 2, 256 * 128 + 256 * 7, 256 * 5],
+}
+
+
+@pytest.mark.parametrize("ggml_type", list(_COUNTS))
+def test_device_dequant_bit_exact(ggml_type):
+    rng = np.random.default_rng(int(ggml_type))
+    for n in _COUNTS[ggml_type]:
+        x = rng.standard_normal(n, dtype=np.float32)
+        buf = quantize(x, ggml_type)
+        want = dequantize(buf, ggml_type, n)
+        got = np.asarray(device_dequant(buf, ggml_type, n))
+        np.testing.assert_array_equal(got, want, err_msg=f"{ggml_type} n={n}")
+
+
+def test_device_dequant_fallback_formats():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(64 * 32, dtype=np.float32)
+    for t in (GGMLType.F16, GGMLType.F32, GGMLType.Q4_0):
+        buf = quantize(x, t)
+        want = dequantize(buf, t, x.size)
+        got = np.asarray(device_dequant(buf, t, x.size))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_device_dequant_bf16_output():
+    rng = np.random.default_rng(1)
+    n = 256 * 512
+    x = rng.standard_normal(n, dtype=np.float32)
+    buf = quantize(x, GGMLType.Q4_K)
+    got = device_dequant(buf, GGMLType.Q4_K, n, dtype=jnp.bfloat16)
+    assert got.dtype == jnp.bfloat16
+    want = dequantize(buf, GGMLType.Q4_K, n)
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), want, rtol=1e-2, atol=1e-2
+    )
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+def _ref_attention(q, k, v, pos_offset, sm_scale, sliding_window=0):
+    """The XLA path from models/llama.py, as a standalone oracle."""
+    S, H, hd = q.shape
+    n_ctx, n_kv, _ = k.shape
+    group = H // n_kv
+    qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
+    kk = k.transpose(1, 0, 2)
+    vv = v.transpose(1, 0, 2)
+    scores = jnp.einsum(
+        "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
+    ) * sm_scale
+    key_pos = jnp.arange(n_ctx)
+    q_pos = pos_offset + jnp.arange(S)
+    mask = key_pos[None, :] <= q_pos[:, None]
+    if sliding_window:
+        mask &= key_pos[None, :] > q_pos[:, None] - sliding_window
+    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
+    ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv)
+    return ctx.transpose(2, 0, 1, 3).reshape(S, H, hd)
+
+
+@pytest.mark.parametrize(
+    "S,n_ctx,H,n_kv,hd,offset,window",
+    [
+        (16, 64, 4, 2, 32, 0, 0),       # prefill from empty cache
+        (16, 64, 4, 2, 32, 13, 0),      # continuation at an offset
+        (32, 128, 8, 8, 16, 0, 0),      # MHA (group=1)
+        (16, 64, 4, 1, 32, 7, 0),       # maximal grouping
+        (16, 64, 4, 2, 32, 9, 24),      # sliding window (Mistral path)
+        (128, 256, 4, 2, 128, 0, 0),    # full-lane head_dim, multi-kv-block
+    ],
+)
+def test_flash_attention_matches_xla(S, n_ctx, H, n_kv, hd, offset, window):
+    keys = jax.random.split(jax.random.PRNGKey(S + n_ctx + H), 3)
+    q = jax.random.normal(keys[0], (S, H, hd), jnp.float32)
+    k = jax.random.normal(keys[1], (n_ctx, n_kv, hd), jnp.float32)
+    v = jax.random.normal(keys[2], (n_ctx, n_kv, hd), jnp.float32)
+    # k/v carry garbage in unwritten ring slots on purpose: the causal mask
+    # must hide them, which is exactly what a real cache relies on
+    sm = hd ** -0.5
+    got = flash_attention(
+        q, k, v, jnp.int32(offset), sm_scale=sm, sliding_window=window,
+        interpret=True,
+    )
+    want = _ref_attention(q, k, v, jnp.int32(offset), sm, window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_pallas_matches_xla_end_to_end():
+    """Full model forward: logits with attn_impl=pallas ≈ attn_impl=xla."""
+    cfg = ModelConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=128, n_ctx=64)
+    params = synth_params(cfg, fmt="bf16", seed=3)
+    tokens = jnp.arange(1, 33, dtype=jnp.int32)
+    length = jnp.int32(32)
+
+    logits_xla, _ = prefill_jit(params, cfg, tokens, length,
+                                init_state(cfg)["cache"])
+    cfg_p = dataclasses.replace(cfg, attn_impl="pallas")
+    logits_pl, _ = prefill_jit(params, cfg_p, tokens, length,
+                               init_state(cfg_p)["cache"])
+    # bf16 weights: tolerance covers softmax-accumulation-order noise
+    np.testing.assert_allclose(
+        np.asarray(logits_pl), np.asarray(logits_xla), rtol=5e-2, atol=5e-2
+    )
+
+
+# ---------------------------------------------------------------------------
+# load path: Pallas dequant + device requant == numpy reference codecs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fmt", ["bf16", "int8"])
+def test_load_params_on_device_matches_host(tmp_path, fmt):
+    from llama_fastapi_k8s_gpu_tpu.gguf import GGUFFile
+    from llama_fastapi_k8s_gpu_tpu.models.params import load_params
+    from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+
+    path = str(tmp_path / "tiny.gguf")
+    cfg = write_tiny_llama_gguf(path, quant=GGMLType.Q4_K,
+                                ffn_quant=GGMLType.Q6_K)
+    gf = GGUFFile(path)
+    host = load_params(gf, cfg, fmt=fmt, on_device=False)
+    dev = load_params(gf, cfg, fmt=fmt, on_device=True)
+    flat_h, tree_h = jax.tree.flatten_with_path(host)
+    flat_d, tree_d = jax.tree.flatten_with_path(dev)
+    assert tree_h == tree_d
+    for (path_h, h), (_, d) in zip(flat_h, flat_d):
+        assert h.dtype == d.dtype and h.shape == d.shape
+        h32 = np.asarray(h, np.float32)
+        d32 = np.asarray(d, np.float32)
+        # XLA folds /127.0 into a reciprocal multiply → int8 scales can be
+        # 1 ulp off the numpy codec, and quantized values ±1 on ties.
+        if fmt == "int8" and h.dtype == jnp.int8:
+            np.testing.assert_allclose(d32, h32, atol=1.0, err_msg=str(path_h))
+        elif fmt == "int8" and h.dtype == jnp.float32:
+            np.testing.assert_allclose(d32, h32, rtol=1e-6, err_msg=str(path_h))
+        else:
+            np.testing.assert_array_equal(d32, h32, err_msg=str(path_h))
