@@ -29,7 +29,10 @@ class ModelConfig:
     tie_embeddings: bool = False
     dtype: str = "bfloat16"
     # "xla" materializes (S, n_ctx) scores; "pallas" streams K/V through the
-    # blockwise flash kernel (ops/pallas/attention.py) on prefill paths.
+    # blockwise flash kernel (ops/pallas/attention.py) on prefill paths;
+    # "ring" shards the sequence over the sp mesh axis — only valid through
+    # the parallel/ring.py entry points (sp_prefill / sp_decode_step), which
+    # establish the mesh context the ring ops need.
     attn_impl: str = "xla"
 
     @property

@@ -69,7 +69,17 @@ def _layer(h, lp, ck, cv, positions, pos_offset, cfg: ModelConfig):
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (pos_offset, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (pos_offset, 0, 0))
 
-    if cfg.attn_impl == "pallas" and S > 1:
+    if cfg.attn_impl == "ring":
+        # sequence-parallel: KV sharded over the sp mesh axis (parallel/ring.py)
+        from ..parallel.ring import ring_attention, sharded_decode_attention
+
+        attn = ring_attention if S > 1 else sharded_decode_attention
+        ctx = attn(
+            q, ck, cv, pos_offset,
+            sm_scale=hd ** -0.5,
+            sliding_window=cfg.sliding_window,
+        ).reshape(S, cfg.n_heads * hd).astype(h.dtype)
+    elif cfg.attn_impl == "pallas" and S > 1:
         # blockwise flash kernel: streams K/V, never materializes scores
         from ..ops.pallas import flash_attention, use_interpret
 

@@ -29,14 +29,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.config import ModelConfig
 
 
-def make_mesh(dp: int = 1, tp: int = 1, devices=None) -> Mesh:
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1, devices=None) -> Mesh:
+    """dp × tp × sp device mesh.  ``sp`` is the sequence-parallel axis used
+    by ring attention (parallel/ring.py); it defaults to 1 so dp/tp-only
+    callers see the same layouts as before."""
     if devices is None:
         devices = jax.devices()
-    n = dp * tp
+    n = dp * tp * sp
     if n > len(devices):
-        raise ValueError(f"mesh {dp}x{tp} needs {n} devices, have {len(devices)}")
-    mesh_devices = mesh_utils.create_device_mesh((dp, tp), devices=devices[:n])
-    return Mesh(mesh_devices, axis_names=("dp", "tp"))
+        raise ValueError(f"mesh {dp}x{tp}x{sp} needs {n} devices, have {len(devices)}")
+    mesh_devices = mesh_utils.create_device_mesh((dp, tp, sp), devices=devices[:n])
+    return Mesh(mesh_devices, axis_names=("dp", "tp", "sp"))
 
 
 def _ns(mesh: Mesh, *spec) -> NamedSharding:

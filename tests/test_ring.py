@@ -1,0 +1,121 @@
+"""Ring attention / sequence parallelism on the 8-virtual-device CPU mesh
+(SURVEY.md §4 "Multi-chip logic tested without hardware").
+
+Oracle: the single-device XLA attention path — sp-sharded prefill/decode
+must produce the same logits and the same cache contents.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.models import ModelConfig, init_cache, prefill
+from llama_fastapi_k8s_gpu_tpu.models.llama import decode_step
+from llama_fastapi_k8s_gpu_tpu.models.params import synth_params
+from llama_fastapi_k8s_gpu_tpu.parallel.mesh import make_mesh, shard_params
+from llama_fastapi_k8s_gpu_tpu.parallel.ring import (
+    ring_attention,
+    ring_context,
+    sharded_decode_attention,
+    sp_prefill,
+    sp_state_shardings,
+)
+
+CFG = ModelConfig(
+    vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, n_ctx=64, rope_theta=10000.0,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(dp=1, tp=2, sp=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(CFG, fmt="bf16", seed=0)
+
+
+def _ref_attention(q, k, v, pos_offset, sm_scale, sliding_window=0):
+    S, H, hd = q.shape
+    n_ctx, n_kv, _ = k.shape
+    group = H // n_kv
+    qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
+    scores = jnp.einsum(
+        "ngsh,nch->ngsc", qg, k.transpose(1, 0, 2),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale
+    key_pos = jnp.arange(n_ctx)
+    q_pos = pos_offset + jnp.arange(S)
+    mask = key_pos[None, :] <= q_pos[:, None]
+    if sliding_window:
+        mask &= key_pos[None, :] > q_pos[:, None] - sliding_window
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    ctx = jnp.einsum("ngsc,nch->ngsh", probs, v.transpose(1, 0, 2))
+    return ctx.transpose(2, 0, 1, 3).reshape(S, H, hd)
+
+
+@pytest.mark.parametrize("offset,window", [(0, 0), (16, 0), (8, 24)])
+def test_ring_attention_matches_reference(mesh, offset, window):
+    S, n_ctx, H, n_kv, hd = 32, 64, 4, 2, 32
+    keys = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(keys[0], (S, H, hd), jnp.float32)
+    k = jax.random.normal(keys[1], (n_ctx, n_kv, hd), jnp.float32)
+    v = jax.random.normal(keys[2], (n_ctx, n_kv, hd), jnp.float32)
+    with ring_context(mesh):
+        got = ring_attention(q, k, v, jnp.int32(offset), sm_scale=hd ** -0.5,
+                             sliding_window=window)
+    want = _ref_attention(q, k, v, jnp.int32(offset), hd ** -0.5, window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sharded_decode_attention_matches_reference(mesh):
+    n_ctx, H, n_kv, hd = 64, 4, 2, 32
+    keys = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(keys[0], (1, H, hd), jnp.float32)
+    k = jax.random.normal(keys[1], (n_ctx, n_kv, hd), jnp.float32)
+    v = jax.random.normal(keys[2], (n_ctx, n_kv, hd), jnp.float32)
+    with ring_context(mesh):
+        got = sharded_decode_attention(q, k, v, jnp.int32(37),
+                                       sm_scale=hd ** -0.5)
+    want = _ref_attention(q, k, v, jnp.int32(37), hd ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp_prefill_matches_single_device(mesh, params):
+    tokens = jnp.arange(1, 33, dtype=jnp.int32)       # S=32, sp=4 → 8/shard
+    length = jnp.int32(32)
+    ref_logits, ref_cache = prefill(params, CFG, tokens, length, init_cache(CFG))
+
+    sharded = shard_params(params, mesh)
+    cache = jax.device_put(init_cache(CFG), sp_state_shardings(CFG, mesh))
+    got_logits, got_cache = sp_prefill(sharded, CFG, tokens, length, cache, mesh)
+    np.testing.assert_allclose(np.asarray(got_logits), np.asarray(ref_logits),
+                               rtol=3e-2, atol=3e-2)
+    np.testing.assert_allclose(
+        np.asarray(got_cache["k"][:, :32], np.float32),
+        np.asarray(ref_cache["k"][:, :32], np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_sp_decode_step_matches_single_device(mesh, params):
+    from llama_fastapi_k8s_gpu_tpu.parallel.ring import sp_decode_step
+
+    tokens = jnp.arange(1, 33, dtype=jnp.int32)
+    length = jnp.int32(32)
+    ref_logits, ref_cache = prefill(params, CFG, tokens, length, init_cache(CFG))
+    want, _ = decode_step(params, CFG, jnp.int32(5), jnp.int32(32), ref_cache)
+
+    sharded = shard_params(params, mesh)
+    cache = jax.device_put(init_cache(CFG), sp_state_shardings(CFG, mesh))
+    _, sp_cache = sp_prefill(sharded, CFG, tokens, length, cache, mesh)
+    got, _ = sp_decode_step(sharded, CFG, jnp.int32(5), jnp.int32(32),
+                            sp_cache, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-2, atol=3e-2)
