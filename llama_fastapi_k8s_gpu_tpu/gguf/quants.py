@@ -360,11 +360,21 @@ def _type_name(ggml_type) -> str:
 
 
 def dequantize(buf: np.ndarray, ggml_type: GGMLType, n_elements: int) -> np.ndarray:
-    """Flat uint8 buffer → float32 array of ``n_elements``."""
+    """Flat uint8 buffer → float32 array of ``n_elements``.
+
+    Routes through the in-tree C++ library (``native/``, multithreaded,
+    bit-exact with the codecs above) when available; numpy otherwise.
+    Disable with ``LFKT_NATIVE=0``.
+    """
     try:
         fn = DEQUANT[GGMLType(ggml_type)]
     except (KeyError, ValueError):
         raise NotImplementedError(f"dequant for {_type_name(ggml_type)}") from None
+    from ..native import native_dequantize
+
+    out = native_dequantize(buf, int(ggml_type), n_elements)
+    if out is not None:
+        return out
     return fn(np.ascontiguousarray(buf, dtype=np.uint8).reshape(-1), n_elements)
 
 

@@ -1,0 +1,175 @@
+"""Native (C++) GGUF load path: build, load, and ctypes bindings.
+
+The reference ships its native engine as a pre-built wheel
+(``llama-cpp-python==0.2.77`` compiled with cuBLAS, reference
+docker/Dockerfile.base:30-32).  Here the native component is in-tree C++
+(``src/gguf_dequant.cpp``) compiled on first use with the host toolchain into
+a cached shared library — multithreaded dequantization of the multi-GB GGUF
+tensor data at model load, bit-exact with the numpy codecs in
+:mod:`..gguf.quants` (the oracle; see tests/test_native.py).
+
+Fallback story: if no C++ compiler is available or the build fails, every
+entry point degrades to the numpy reference implementation.  Set
+``LFKT_NATIVE=0`` to force the numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src", "gguf_dequant.cpp")
+
+# -ffp-contract=off: the kernels must round exactly like numpy's separate
+# multiply/subtract ops; FMA contraction would change the last bit.
+_CXXFLAGS = ["-O3", "-march=native", "-ffp-contract=off", "-fPIC", "-shared",
+             "-std=c++17", "-pthread"]
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+
+def _enabled() -> bool:
+    return os.environ.get("LFKT_NATIVE", "1").strip().lower() not in ("0", "false", "no", "off")
+
+
+def _cache_dirs() -> list[str]:
+    here = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+    xdg = os.environ.get("XDG_CACHE_HOME") or os.path.join(os.path.expanduser("~"), ".cache")
+    return [here, os.path.join(xdg, "lfkt_native"), os.path.join(tempfile.gettempdir(), "lfkt_native")]
+
+
+def _build(so_path: str) -> bool:
+    cxx = os.environ.get("CXX", "g++")
+    tmp = so_path + f".tmp.{os.getpid()}"
+    cmd = [cxx, *_CXXFLAGS, "-o", tmp, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.info("native build unavailable (%s); using numpy dequant", e)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native build failed; using numpy dequant:\n%s", proc.stderr[-2000:])
+        return False
+    try:
+        os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+    except OSError:
+        return False
+    return True
+
+
+def _host_tag() -> str:
+    """Compiler + microarch fingerprint: -march=native binaries must never be
+    reused on a different host/compiler (SIGILL on older CPUs)."""
+    import platform
+
+    cxx = os.environ.get("CXX", "g++")
+    try:
+        ver = subprocess.run([cxx, "-dumpfullversion", "-dumpversion"],
+                             capture_output=True, text=True, timeout=10).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        ver = "unknown"
+    march = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    march = hashlib.sha256(line.encode()).hexdigest()[:8]
+                    break
+    except OSError:
+        march = platform.machine()
+    return f"{cxx}-{ver}-{march}"
+
+
+def _load() -> ctypes.CDLL | None:
+    with open(_SRC, "rb") as f:
+        payload = f.read() + " ".join(_CXXFLAGS).encode() + _host_tag().encode()
+    tag = hashlib.sha256(payload).hexdigest()[:16]
+    name = f"gguf_dequant-{tag}.so"
+    for d in _cache_dirs():
+        so_path = os.path.join(d, name)
+        if not os.path.exists(so_path):
+            try:
+                os.makedirs(d, exist_ok=True)
+            except OSError:
+                continue
+            if not _build(so_path):
+                continue  # unwritable dir or failed build: try the next cache
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError:
+            continue
+        lib.lfkt_dequant.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.lfkt_dequant.restype = ctypes.c_int
+        lib.lfkt_supported.argtypes = [ctypes.c_int]
+        lib.lfkt_supported.restype = ctypes.c_int
+        return lib
+    return None
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it on first call; None if unavailable."""
+    global _lib, _load_attempted
+    if not _enabled():
+        return None
+    if _load_attempted:
+        return _lib
+    with _lock:
+        if not _load_attempted:
+            _lib = _load()
+            _load_attempted = True
+            if _lib is not None:
+                logger.info("native GGUF dequant library loaded")
+    return _lib
+
+
+def _required_bytes(ggml_type: int, n_elements: int) -> int:
+    from ..gguf.constants import GGML_BLOCK_SIZES, GGMLType
+
+    block_elems, block_bytes = GGML_BLOCK_SIZES[GGMLType(ggml_type)]
+    if n_elements % block_elems != 0:
+        return n_elements * block_bytes  # force fallback; numpy raises cleanly
+    return (n_elements // block_elems) * block_bytes
+
+
+def native_supported(ggml_type: int) -> bool:
+    lib = get_lib()
+    return bool(lib is not None and lib.lfkt_supported(int(ggml_type)))
+
+
+def native_dequantize(buf: np.ndarray, ggml_type: int, n_elements: int,
+                      n_threads: int = 0) -> np.ndarray | None:
+    """Flat uint8 buffer -> float32 array, or None if the native path can't
+    serve this type (caller falls back to numpy)."""
+    if not native_supported(ggml_type):
+        return None
+    lib = get_lib()
+    src = np.ascontiguousarray(buf, dtype=np.uint8).reshape(-1)
+    if src.size < _required_bytes(int(ggml_type), n_elements):
+        # short/corrupt buffer: let the numpy path raise its shape error
+        return None
+    out = np.empty(n_elements, dtype=np.float32)
+    rc = lib.lfkt_dequant(
+        int(ggml_type),
+        src.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n_elements),
+        out.ctypes.data_as(ctypes.c_void_p),
+        int(n_threads),
+    )
+    if rc != 0:
+        logger.warning("native dequant rc=%d for type %d; numpy fallback", rc, ggml_type)
+        return None
+    return out

@@ -1,0 +1,296 @@
+// Native GGUF dequantization: the in-tree C++ analogue of the reference's
+// native engine dependency (llama-cpp-python==0.2.77 C/CUDA kernels, reference
+// docker/Dockerfile.base:30-32).  The TPU framework keeps the *compute* path
+// in JAX/XLA/Pallas; this library accelerates the host-side load path — the
+// multi-GB GGUF -> float32 conversion that happens once at model load —
+// with multithreaded scalar kernels that g++ auto-vectorizes.
+//
+// Contract: bit-exact with the numpy reference codecs in gguf/quants.py
+// (enforced by tests/test_native.py).  All arithmetic is float32 with the
+// same operation order as the numpy expressions.
+//
+// C ABI (ctypes-friendly):
+//   int lfkt_dequant(int ggml_type, const uint8_t* src, int64_t n_elements,
+//                    float* dst, int n_threads);
+//     returns 0 on success, -1 for unsupported type, -2 for bad args.
+//   int lfkt_supported(int ggml_type);  // 1 if the type is handled
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---- ggml type codes (gguf/constants.py GGMLType) --------------------------
+enum GgmlType : int {
+  T_F32 = 0,
+  T_F16 = 1,
+  T_Q4_0 = 2,
+  T_Q8_0 = 8,
+  T_Q4_K = 12,
+  T_Q5_K = 13,
+  T_Q6_K = 14,
+  T_BF16 = 30,
+};
+
+constexpr int QK_K = 256;
+
+// ---- IEEE f16 -> f32 (exact, matches numpy's astype) -----------------------
+float f16_to_f32_slow(uint16_t h) {
+  uint32_t sign = static_cast<uint32_t>(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1Fu;
+  uint32_t man = h & 0x3FFu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;  // +-0
+    } else {        // subnormal: renormalize
+      int e = -1;
+      uint32_t m = man;
+      do {
+        e++;
+        m <<= 1;
+      } while (!(m & 0x400u));
+      m &= 0x3FFu;
+      bits = sign | (static_cast<uint32_t>(127 - 15 - e) << 23) | (m << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (man << 13);  // inf / nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+// one 256 KiB table beats per-element bit twiddling on the load path
+struct F16Table {
+  float v[65536];
+  F16Table() {
+    for (uint32_t i = 0; i < 65536; i++) v[i] = f16_to_f32_slow(static_cast<uint16_t>(i));
+  }
+};
+const F16Table kF16;
+
+inline float f16(const uint8_t* p) {
+  uint16_t h;
+  std::memcpy(&h, p, 2);
+  return kF16.v[h];
+}
+
+// ---- per-block kernels (layouts: gguf/quants.py:15-24) ---------------------
+
+// Q8_0  block=32: f16 d | 32 x i8
+void deq_q8_0(const uint8_t* b, float* y) {
+  const float d = f16(b);
+  const int8_t* q = reinterpret_cast<const int8_t*>(b + 2);
+  for (int i = 0; i < 32; i++) y[i] = d * static_cast<float>(q[i]);
+}
+
+// Q4_0  block=32: f16 d | 16 B nibbles; elements 0..15 = lo, 16..31 = hi
+void deq_q4_0(const uint8_t* b, float* y) {
+  const float d = f16(b);
+  const uint8_t* qs = b + 2;
+  for (int i = 0; i < 16; i++) {
+    y[i] = d * (static_cast<float>(qs[i] & 0x0F) - 8.0f);
+    y[i + 16] = d * (static_cast<float>(qs[i] >> 4) - 8.0f);
+  }
+}
+
+// shared K-quant 6-bit scale/min unpack (gguf/quants.py unpack_scale_min_k4)
+inline void scale_min_k4(const uint8_t* s, uint8_t* sc, uint8_t* mn) {
+  for (int j = 0; j < 4; j++) {
+    sc[j] = s[j] & 63;
+    mn[j] = s[j + 4] & 63;
+  }
+  for (int j = 4; j < 8; j++) {
+    sc[j] = static_cast<uint8_t>((s[j + 4] & 0x0F) | ((s[j - 4] >> 6) << 4));
+    mn[j] = static_cast<uint8_t>((s[j + 4] >> 4) | ((s[j] >> 6) << 4));
+  }
+}
+
+// Q4_K  block=256 (144 B): f16 d | f16 dmin | 12 B scales | 128 B nibbles
+// sub-block 2g from low nibble of qs[32g..32g+32), 2g+1 from high nibble
+void deq_q4_k(const uint8_t* b, float* y) {
+  const float d = f16(b);
+  const float dmin = f16(b + 2);
+  uint8_t sc[8], mn[8];
+  scale_min_k4(b + 4, sc, mn);
+  const uint8_t* qs = b + 16;
+  for (int g = 0; g < 4; g++) {
+    const float s_lo = d * static_cast<float>(sc[2 * g]);
+    const float m_lo = dmin * static_cast<float>(mn[2 * g]);
+    const float s_hi = d * static_cast<float>(sc[2 * g + 1]);
+    const float m_hi = dmin * static_cast<float>(mn[2 * g + 1]);
+    const uint8_t* q = qs + 32 * g;
+    float* lo = y + 64 * g;
+    float* hi = lo + 32;
+    for (int i = 0; i < 32; i++) {
+      lo[i] = s_lo * static_cast<float>(q[i] & 0x0F) - m_lo;
+      hi[i] = s_hi * static_cast<float>(q[i] >> 4) - m_hi;
+    }
+  }
+}
+
+// Q5_K  block=256 (176 B): f16 d | f16 dmin | 12 B scales | 32 B qh | 128 B qs
+// sub-block j: low/high nibble as Q4_K, plus 16 * ((qh >> j) & 1)
+void deq_q5_k(const uint8_t* b, float* y) {
+  const float d = f16(b);
+  const float dmin = f16(b + 2);
+  uint8_t sc[8], mn[8];
+  scale_min_k4(b + 4, sc, mn);
+  const uint8_t* qh = b + 16;
+  const uint8_t* qs = b + 48;
+  for (int g = 0; g < 4; g++) {
+    const int j_lo = 2 * g, j_hi = 2 * g + 1;
+    const float s_lo = d * static_cast<float>(sc[j_lo]);
+    const float m_lo = dmin * static_cast<float>(mn[j_lo]);
+    const float s_hi = d * static_cast<float>(sc[j_hi]);
+    const float m_hi = dmin * static_cast<float>(mn[j_hi]);
+    const uint8_t* q = qs + 32 * g;
+    float* lo = y + 64 * g;
+    float* hi = lo + 32;
+    for (int i = 0; i < 32; i++) {
+      const int h_lo = (qh[i] >> j_lo) & 1;
+      const int h_hi = (qh[i] >> j_hi) & 1;
+      lo[i] = s_lo * static_cast<float>((q[i] & 0x0F) + 16 * h_lo) - m_lo;
+      hi[i] = s_hi * static_cast<float>((q[i] >> 4) + 16 * h_hi) - m_hi;
+    }
+  }
+}
+
+// Q6_K  block=256 (210 B): 128 B ql | 64 B qh | 16 x i8 scales | f16 d
+// two 128-element halves; within a half, element l (0..127):
+//   low  = (l < 64 ? ql[l] & 0xF : ql[l-64] >> 4)
+//   high = (qh[l % 32] >> (2 * (l / 32))) & 3
+//   q    = (low | high << 4) - 32, sub-block scale sc[l / 16]
+void deq_q6_k(const uint8_t* b, float* y) {
+  const int8_t* scales = reinterpret_cast<const int8_t*>(b + 192);
+  const float d = f16(b + 208);
+  for (int half = 0; half < 2; half++) {
+    const uint8_t* ql = b + 64 * half;
+    const uint8_t* qh = b + 128 + 32 * half;
+    float* yo = y + 128 * half;
+    for (int l = 0; l < 128; l++) {
+      const int low = (l < 64) ? (ql[l] & 0x0F) : (ql[l - 64] >> 4);
+      const int high = (qh[l & 31] >> (2 * (l >> 5))) & 3;
+      const int q = (low | (high << 4)) - 32;
+      const float dsc =
+          d * static_cast<float>(scales[8 * half + (l >> 4)]);
+      yo[l] = dsc * static_cast<float>(q);
+    }
+  }
+}
+
+// ---- format table ----------------------------------------------------------
+struct Fmt {
+  int type;
+  int64_t block_elems;
+  int64_t block_bytes;
+  void (*fn)(const uint8_t*, float*);
+};
+
+const Fmt kFmts[] = {
+    {T_Q8_0, 32, 34, deq_q8_0},
+    {T_Q4_0, 32, 18, deq_q4_0},
+    {T_Q4_K, QK_K, 144, deq_q4_k},
+    {T_Q5_K, QK_K, 176, deq_q5_k},
+    {T_Q6_K, QK_K, 210, deq_q6_k},
+};
+
+const Fmt* find_fmt(int type) {
+  for (const Fmt& f : kFmts)
+    if (f.type == type) return &f;
+  return nullptr;
+}
+
+// ---- float formats (threaded memcpy/convert) -------------------------------
+void conv_range_f32(const uint8_t* src, float* dst, int64_t lo, int64_t hi) {
+  std::memcpy(dst + lo, src + 4 * lo, 4 * static_cast<size_t>(hi - lo));
+}
+
+void conv_range_f16(const uint8_t* src, float* dst, int64_t lo, int64_t hi) {
+  for (int64_t i = lo; i < hi; i++) dst[i] = f16(src + 2 * i);
+}
+
+void conv_range_bf16(const uint8_t* src, float* dst, int64_t lo, int64_t hi) {
+  for (int64_t i = lo; i < hi; i++) {
+    uint16_t h;
+    std::memcpy(&h, src + 2 * i, 2);
+    uint32_t bits = static_cast<uint32_t>(h) << 16;
+    std::memcpy(dst + i, &bits, 4);
+  }
+}
+
+template <typename F>
+void run_threads(int64_t n_units, int n_threads, F&& body) {
+  if (n_threads <= 1 || n_units < 2 * n_threads) {
+    body(0, n_units);
+    return;
+  }
+  std::vector<std::thread> ts;
+  ts.reserve(static_cast<size_t>(n_threads));
+  const int64_t per = (n_units + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    const int64_t lo = t * per;
+    const int64_t hi = std::min<int64_t>(lo + per, n_units);
+    if (lo >= hi) break;
+    ts.emplace_back([&body, lo, hi] { body(lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+int lfkt_supported(int ggml_type) {
+  return (ggml_type == T_F32 || ggml_type == T_F16 || ggml_type == T_BF16 ||
+          find_fmt(ggml_type) != nullptr)
+             ? 1
+             : 0;
+}
+
+int lfkt_dequant(int ggml_type, const uint8_t* src, int64_t n_elements,
+                 float* dst, int n_threads) {
+  if (!src || !dst || n_elements < 0) return -2;
+  if (n_threads <= 0)
+    n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads <= 0) n_threads = 1;
+
+  switch (ggml_type) {
+    case T_F32:
+      run_threads(n_elements, n_threads, [&](int64_t lo, int64_t hi) {
+        conv_range_f32(src, dst, lo, hi);
+      });
+      return 0;
+    case T_F16:
+      run_threads(n_elements, n_threads, [&](int64_t lo, int64_t hi) {
+        conv_range_f16(src, dst, lo, hi);
+      });
+      return 0;
+    case T_BF16:
+      run_threads(n_elements, n_threads, [&](int64_t lo, int64_t hi) {
+        conv_range_bf16(src, dst, lo, hi);
+      });
+      return 0;
+    default:
+      break;
+  }
+
+  const Fmt* fmt = find_fmt(ggml_type);
+  if (!fmt) return -1;
+  if (n_elements % fmt->block_elems != 0) return -2;
+  const int64_t n_blocks = n_elements / fmt->block_elems;
+  run_threads(n_blocks, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t blk = lo; blk < hi; blk++) {
+      fmt->fn(src + blk * fmt->block_bytes, dst + blk * fmt->block_elems);
+    }
+  });
+  return 0;
+}
+
+}  // extern "C"
