@@ -19,6 +19,7 @@ import ctypes
 import hashlib
 import logging
 import os
+import shutil
 import subprocess
 import tempfile
 import threading
@@ -62,7 +63,7 @@ def _build(so_path: str) -> bool:
         logger.warning("native build failed; using numpy dequant:\n%s", proc.stderr[-2000:])
         return False
     try:
-        os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+        os.replace(tmp, so_path)
     except OSError:
         return False
     return True
@@ -91,33 +92,55 @@ def _host_tag() -> str:
     return f"{cxx}-{ver}-{march}"
 
 
+def _bind(so_path: str) -> ctypes.CDLL | None:
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+    lib.lfkt_dequant.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.lfkt_dequant.restype = ctypes.c_int
+    lib.lfkt_supported.argtypes = [ctypes.c_int]
+    lib.lfkt_supported.restype = ctypes.c_int
+    return lib
+
+
 def _load() -> ctypes.CDLL | None:
     with open(_SRC, "rb") as f:
         payload = f.read() + " ".join(_CXXFLAGS).encode() + _host_tag().encode()
     tag = hashlib.sha256(payload).hexdigest()[:16]
     name = f"gguf_dequant-{tag}.so"
+
     for d in _cache_dirs():
         so_path = os.path.join(d, name)
-        if not os.path.exists(so_path):
-            try:
-                os.makedirs(d, exist_ok=True)
-            except OSError:
-                continue
-            if not _build(so_path):
-                continue  # unwritable dir or failed build: try the next cache
+        if os.path.exists(so_path):
+            lib = _bind(so_path)
+            if lib is not None:
+                return lib
+
+    # Compile exactly once, into a tmpdir we know is writable.  A compile
+    # failure is a property of the toolchain, not the cache dir — don't
+    # retry it per directory.
+    build_dir = tempfile.mkdtemp(prefix="lfkt_build_")
+    built = os.path.join(build_dir, name)
+    if not _build(built):
+        return None
+
+    for d in _cache_dirs():  # promote into a persistent cache for next start
+        so_path = os.path.join(d, name)
         try:
-            lib = ctypes.CDLL(so_path)
+            os.makedirs(d, exist_ok=True)
+            tmp = os.path.join(d, f"{name}.tmp.{os.getpid()}")
+            shutil.copyfile(built, tmp)
+            os.replace(tmp, so_path)
         except OSError:
             continue
-        lib.lfkt_dequant.argtypes = [
-            ctypes.c_int, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_void_p, ctypes.c_int,
-        ]
-        lib.lfkt_dequant.restype = ctypes.c_int
-        lib.lfkt_supported.argtypes = [ctypes.c_int]
-        lib.lfkt_supported.restype = ctypes.c_int
-        return lib
-    return None
+        lib = _bind(so_path)
+        if lib is not None:
+            return lib
+    return _bind(built)  # all caches unwritable: serve from the tmp build
 
 
 def get_lib() -> ctypes.CDLL | None:
