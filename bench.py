@@ -16,6 +16,12 @@ bytes/token, which synthetic weights reproduce exactly).
 Run standalone and ALONE (the device tunnel is single-session):
     python bench.py            # real chip, 8B
     LFKT_BENCH_PRESET=tiny JAX_PLATFORMS=cpu python bench.py   # smoke
+
+Timing note: on the tunneled device platform ``jax.block_until_ready`` can
+return before execution finishes, so every measured section ends with a
+small host fetch (``int(scalar)`` / ``np.asarray`` of a few tokens), which
+is the only reliable sync.  All decode chunks are data-dependent (donated
+state chain), so one final fetch syncs the whole chain.
 """
 
 from __future__ import annotations
@@ -26,7 +32,14 @@ import sys
 import time
 
 import jax
+import numpy as np
 import jax.numpy as jnp
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # a site hook may pre-register the tunneled device platform and override
+    # the env var at startup; the post-import config update wins if no
+    # backend is initialized yet (same defense as tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -94,7 +107,10 @@ def main():
     dev = jax.devices()[0]
     t0 = time.time()
     params = synth_int8_device(cfg)
-    jax.block_until_ready(params)
+    # sync: reduce EVERY leaf to a scalar and fetch it (block_until_ready is
+    # unreliable on the tunneled platform; partial fetches leak into compile_s)
+    float(sum(x.sum().astype(jnp.float32)
+              for x in jax.tree_util.tree_leaves(params)))
     load_s = time.time() - t0
 
     sp = SamplingParams()
@@ -108,7 +124,7 @@ def main():
         window, wpos = seed_window(prompt)
         tok, window, wpos, key = sample_jit(logits, window, wpos,
                                             jax.random.PRNGKey(0), st, cfg)
-        jax.block_until_ready(tok)
+        int(tok)  # host fetch: the only reliable sync on the tunneled device
         return {
             "cache": cache, "pos": jnp.int32(prompt_len), "token": tok,
             "window": window, "wpos": wpos, "key": key,
@@ -117,7 +133,7 @@ def main():
     # warmup: compile prefill + decode-chunk
     state = one_request(init_state(cfg))
     state, _ = generate_chunk_jit(params, cfg, state, st, n_steps=chunk)
-    jax.block_until_ready(state["pos"])
+    int(state["pos"])
     compile_s = time.time() - t0 - load_s
 
     # TTFT: prompt → first sampled token (steady-state, median of 5)
@@ -134,7 +150,7 @@ def main():
     t2 = time.time()
     for _ in range(n_chunks):
         state, toks = generate_chunk_jit(params, cfg, state, st, n_steps=chunk)
-    jax.block_until_ready(toks)
+    np.asarray(toks)  # chunks chain through donated state: one fetch syncs all
     decode_s = time.time() - t2
     tok_s = (n_chunks * chunk) / decode_s
 

@@ -35,10 +35,27 @@ from ..models.llama import init_cache
 from ..models.params import load_params, synth_params
 from ..sampling.sample import SamplingParams, sampling_tensors, seed_window
 from ..tokenizer import apply_chat_template, detect_chat_template, tokenizer_from_gguf
+from ..utils.tracing import maybe_profile
 
 logger = logging.getLogger(__name__)
 
 DEFAULT_BUCKETS = (128, 256, 512, 1024)
+
+
+def _setup_compile_cache():
+    """Persistent XLA compilation cache (SURVEY.md §5 "Checkpoint / resume"):
+    cuts the jit-warmup cost of a pod restart from minutes to seconds.  Off
+    unless LFKT_COMPILE_CACHE_DIR is set."""
+    import os
+
+    d = os.environ.get("LFKT_COMPILE_CACHE_DIR")
+    if not d:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # noqa: BLE001 — older jax: serve without the cache
+        logger.warning("compilation cache unavailable: %s", e)
 
 
 class Engine:
@@ -63,6 +80,11 @@ class Engine:
         self._lock = threading.Lock()
         self._base_seed = seed
         self._requests = 0
+        #: per-phase wall timings of the most recent completed request
+        #: (ttft_s, decode_s, completion_tokens, tokens_per_sec) — the
+        #: per-phase timers SURVEY.md §5 calls for; scraped into /metrics.
+        self.last_timings: dict | None = None
+        _setup_compile_cache()
 
         if _parts is not None:
             self.params, self.cfg, self.tokenizer, self.template_kind = _parts
@@ -185,6 +207,7 @@ class Engine:
     # ------------------------------------------------------------------
     def _start(self, messages, sp: SamplingParams, seed):
         """Shared prefill + first-token path. Returns a mutable gen context."""
+        t0 = time.time()
         ids = self.tokenize_messages(messages)
         n_prompt = len(ids)
         if n_prompt >= self.cfg.n_ctx:
@@ -215,14 +238,25 @@ class Engine:
             "wpos": wpos,
             "key": key,
         }
+        first = int(token)  # device sync: first token is now materialized
         return {
             "state": state, "st": st, "sp": sp, "n_prompt": n_prompt,
-            "ids": [], "first": int(token),
+            "ids": [], "first": first, "t0": t0, "ttft_s": time.time() - t0,
         }
 
     def _finish(self, ctx):
-        """Return the cache buffer for reuse by the next request."""
+        """Return the cache buffer for reuse; finalize per-phase timings."""
         self._cache = ctx["state"]["cache"]
+        decode_s = time.time() - ctx["t0"] - ctx["ttft_s"]
+        n = len(ctx["ids"])
+        self.last_timings = {
+            "ttft_s": ctx["ttft_s"],
+            "decode_s": decode_s,
+            "prompt_tokens": ctx["n_prompt"],
+            "completion_tokens": n,
+            # first token came out of prefill; the decode phase produced n-1
+            "tokens_per_sec": (n - 1) / decode_s if n > 1 and decode_s > 0 else 0.0,
+        }
 
     def _token_budget(self, max_tokens, n_prompt):
         budget = self.max_gen_tokens if max_tokens is None else max_tokens
@@ -240,8 +274,23 @@ class Engine:
                 cut = i
         return cut
 
+    def _next_steps(self, produced: int, pos: int, budget: int) -> int:
+        """Size of the next decode chunk given host-tracked progress (no
+        device sync: ``pos`` is n_prompt + decoded count, tracked on host)."""
+        n = min(self.decode_chunk, budget - produced)
+        n = min(n, self.cfg.n_ctx - pos - 1)  # cache slots n_prompt..n_ctx-1
+        return max(0, n)
+
     def _run(self, ctx, max_tokens, stops):
-        """Generate tokens; yields (new_text, done, finish_reason) increments."""
+        """Generate tokens; yields (new_text, done, finish_reason) increments.
+
+        Decode is **pipelined**: chunk k+1 is dispatched to the device before
+        chunk k's tokens are fetched to the host, so the host↔device
+        round-trip (tens of ms over a tunneled device) overlaps with compute.
+        If a stop lands mid-chunk the speculative chunk's cache writes are
+        harmless — attention masks by position and every request re-prefills
+        and reseeds the sampler window, so stale slots are never read.
+        """
         stop_ids = self.tokenizer.stop_ids
         budget = self._token_budget(max_tokens, ctx["n_prompt"])
         gen: list[int] = []
@@ -256,29 +305,35 @@ class Engine:
             return
         gen.append(first)
 
-        done = False
-        while not done:
-            remaining = budget - len(gen)
-            if remaining <= 0:
-                finish = "length"
-                break
-            n_steps = min(self.decode_chunk, remaining)
-            # cache slots: positions n_prompt .. n_ctx-1
-            if int(ctx["state"]["pos"]) + n_steps >= self.cfg.n_ctx:
-                n_steps = self.cfg.n_ctx - int(ctx["state"]["pos"]) - 1
-                if n_steps <= 0:
-                    finish = "length"
-                    break
-            ctx["state"], tokens = generate_chunk_jit(
+        pos = ctx["n_prompt"] + 1  # host-tracked cache position
+        n_cur = self._next_steps(len(gen), pos, budget)
+        pending = None
+        if n_cur > 0:
+            ctx["state"], pending = generate_chunk_jit(
                 self.params, self.cfg, ctx["state"], ctx["st"],
-                n_steps=n_steps, top_k=ctx["sp"].top_k,
-            )
-            for t in np.asarray(tokens).tolist():
+                n_steps=n_cur, top_k=ctx["sp"].top_k)
+
+        done = pending is None
+        while not done:
+            # dispatch the NEXT chunk before touching the host copy of the
+            # current one (speculating that no stop token appears)
+            pos += n_cur
+            n_nxt = self._next_steps(len(gen) + n_cur, pos, budget)
+            nxt = None
+            if n_nxt > 0:
+                ctx["state"], nxt = generate_chunk_jit(
+                    self.params, self.cfg, ctx["state"], ctx["st"],
+                    n_steps=n_nxt, top_k=ctx["sp"].top_k)
+
+            for t in np.asarray(pending).tolist():   # host sync, overlapped
                 if t in stop_ids:
                     finish = "stop"
                     done = True
                     break
                 gen.append(t)
+            pending, n_cur = nxt, n_nxt
+            if pending is None:
+                done = True
 
             text = self._decode_text(gen)
             cut = self._find_stop_str(text, stops)
@@ -303,7 +358,7 @@ class Engine:
 
     # ------------------------------------------------------------------
     def _generate(self, messages, sp, max_tokens, stops, seed) -> dict:
-        with self._lock:
+        with self._lock, maybe_profile("generate"):
             t0 = time.time()
             ctx = self._start(messages, sp, seed)
             parts = []

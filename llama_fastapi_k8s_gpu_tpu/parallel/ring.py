@@ -62,6 +62,38 @@ def current_ring_context():
 
 
 # ---------------------------------------------------------------------------
+# shared GQA chunk-scores core (used by both the prefill ring and the decode
+# LSE combine — one implementation so mask semantics can never desync)
+# ---------------------------------------------------------------------------
+
+def _group_queries(q: jax.Array, n_kv: int) -> jax.Array:
+    """(S, H, hd) → (n_kv, group, S, hd) grouped-query layout."""
+    S, H, hd = q.shape
+    return q.reshape(S, n_kv, H // n_kv, hd).transpose(1, 2, 0, 3)
+
+
+def _masked_chunk_scores(qg, k_chunk, v_chunk, q_pos, key_offset,
+                         sm_scale, sliding_window):
+    """Scores of grouped queries against one KV chunk whose global positions
+    start at ``key_offset``, causal (+ optional sliding-window) masked.
+
+    Returns ``(scores, vv)`` with scores (n_kv, group, S, C_loc) f32 and
+    vv (n_kv, C_loc, hd) ready for the ``ngsc,nch->ngsh`` PV einsum.
+    """
+    C_loc = k_chunk.shape[0]
+    kk = k_chunk.transpose(1, 0, 2)                    # (n_kv, C_loc, hd)
+    vv = v_chunk.transpose(1, 0, 2)
+    scores = jnp.einsum(
+        "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
+    ) * sm_scale
+    key_pos = (key_offset + jnp.arange(C_loc))[None, :]
+    mask = key_pos <= q_pos
+    if sliding_window:
+        mask &= key_pos > q_pos - sliding_window
+    return jnp.where(mask[None, None], scores, DEFAULT_MASK_VALUE), vv
+
+
+# ---------------------------------------------------------------------------
 # prefill: seq-sharded queries over the rotating KV ring
 # ---------------------------------------------------------------------------
 
@@ -85,7 +117,7 @@ def ring_attention(
         S_loc, H, hd = q.shape
         C_loc, n_kv, _ = k.shape
         group = H // n_kv
-        qg = q.reshape(S_loc, n_kv, group, hd).transpose(1, 2, 0, 3)
+        qg = _group_queries(q, n_kv)
         q_pos = (pos_offset + s_idx * S_loc + jnp.arange(S_loc))[:, None]
 
         perm = [(j, (j + 1) % n_ring) for j in range(n_ring)]
@@ -96,16 +128,8 @@ def ring_attention(
         def step(i, carry):
             m, l, acc, k_cur, v_cur = carry
             src = jax.lax.rem(s_idx - i + n_ring, n_ring)  # chunk owner
-            kk = k_cur.transpose(1, 0, 2)                  # (n_kv, C_loc, hd)
-            vv = v_cur.transpose(1, 0, 2)
-            scores = jnp.einsum(
-                "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
-            ) * sm_scale                                   # (n_kv, group, S, C)
-            key_pos = (src * C_loc + jnp.arange(C_loc))[None, :]
-            mask = key_pos <= q_pos
-            if sliding_window:
-                mask &= key_pos > q_pos - sliding_window
-            scores = jnp.where(mask[None, None], scores, DEFAULT_MASK_VALUE)
+            scores, vv = _masked_chunk_scores(
+                qg, k_cur, v_cur, q_pos, src * C_loc, sm_scale, sliding_window)
 
             m_cur = jnp.max(scores, axis=-1, keepdims=True)
             m_new = jnp.maximum(m, m_cur)
@@ -157,19 +181,10 @@ def sharded_decode_attention(
         s_idx = jax.lax.axis_index(ax)
         S, H, hd = q.shape
         C_loc, n_kv, _ = k.shape
-        group = H // n_kv
-        qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
-        kk = k.transpose(1, 0, 2)
-        vv = v.transpose(1, 0, 2)
-        scores = jnp.einsum(
-            "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
-        ) * sm_scale
+        qg = _group_queries(q, n_kv)
         q_pos = (pos_offset + jnp.arange(S))[:, None]
-        key_pos = (s_idx * C_loc + jnp.arange(C_loc))[None, :]
-        mask = key_pos <= q_pos
-        if sliding_window:
-            mask &= key_pos > q_pos - sliding_window
-        scores = jnp.where(mask[None, None], scores, DEFAULT_MASK_VALUE)
+        scores, vv = _masked_chunk_scores(
+            qg, k, v, q_pos, s_idx * C_loc, sm_scale, sliding_window)
 
         m_loc = jnp.max(scores, axis=-1, keepdims=True)
         p = jnp.exp(scores - m_loc)

@@ -99,6 +99,8 @@ def create_app(engine=None, settings: Settings | None = None,
             request_data = await queue.get()
             messages = request_data["messages"]
             future = request_data["future"]
+            app.state.metrics.observe(
+                "queue_wait_seconds", time.time() - request_data["enqueued_at"])
             if future.cancelled():
                 logger.info("Future was cancelled before processing; skipping.")
                 queue.task_done()
@@ -134,6 +136,12 @@ def create_app(engine=None, settings: Settings | None = None,
                     presence_penalty=settings.presence_penalty,
                 )
                 m.observe("generation_seconds", time.time() - t0)
+                timings = getattr(app.state.engine, "last_timings", None)
+                if timings:
+                    m.observe("engine_ttft_seconds", timings["ttft_s"])
+                    if timings["tokens_per_sec"]:
+                        m.observe("engine_decode_tokens_per_sec",
+                                  timings["tokens_per_sec"])
                 if not isinstance(answer, dict):
                     logger.error("Unexpected response type: %s. Response: %s",
                                  type(answer), answer)
@@ -182,7 +190,8 @@ def create_app(engine=None, settings: Settings | None = None,
         loop = asyncio.get_running_loop()
         future = loop.create_future()
         try:
-            queue.put_nowait({"messages": messages, "future": future})
+            queue.put_nowait({"messages": messages, "future": future,
+                              "enqueued_at": time.time()})
         except asyncio.QueueFull:
             m.inc("requests_rejected_total")
             raise HTTPException(status_code=503,

@@ -38,6 +38,15 @@ def test_response_shape(engine):
     assert u["completion_tokens"] <= 8
 
 
+def test_per_phase_timings_recorded(engine):
+    out = engine.create_chat_completion(MSGS, max_tokens=8, seed=0)
+    t = engine.last_timings
+    assert t is not None and t["ttft_s"] > 0 and t["decode_s"] >= 0
+    assert t["completion_tokens"] == out["usage"]["completion_tokens"]
+    if t["completion_tokens"] > 1:
+        assert t["tokens_per_sec"] > 0
+
+
 def test_greedy_deterministic(engine):
     a = engine.create_chat_completion(MSGS, temperature=0.0, max_tokens=8)
     b = engine.create_chat_completion(MSGS, temperature=0.0, max_tokens=8)

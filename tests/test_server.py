@@ -141,6 +141,7 @@ async def test_health_and_metrics_and_items():
             assert m.status_code == 200
             assert "request_seconds_count" in m.text
             assert "queue_depth" in m.text
+            assert "queue_wait_seconds" in m.text  # per-phase timers, SURVEY §5
 
             i = await client.get("/items/7")
             assert i.json() == {"item_id": 7}
