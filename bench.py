@@ -62,13 +62,27 @@ TINY = ModelConfig(vocab_size=512, dim=128, n_layers=2, n_heads=8,
                    n_kv_heads=4, ffn_dim=256, n_ctx=256)
 
 
-def synth_int8_device(cfg: ModelConfig, seed: int = 0) -> dict:
-    """Device-side random int8 params (no multi-GB host RNG / transfer)."""
+def synth_int8_device(cfg: ModelConfig, seed: int = 0, fmt: str = "int8") -> dict:
+    """Device-side random params (no multi-GB host RNG / transfer).
+
+    ``fmt="int8"``: per-channel int8 (ops/linear.py).  ``fmt="q4k"``: the
+    fused Q4_K kernel layout (ops/pallas/qmatmul.py) — random packed nibbles
+    + small scales; decode bandwidth is value-independent, so this measures
+    exactly what real Q4_K weights would.
+    """
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import TK, q4k_compatible
+
     kv_dim = cfg.n_kv_heads * cfg.head_dim
     L = cfg.n_layers
     key = jax.random.PRNGKey(seed)
 
     def lin(k, out_dim, in_dim):
+        if fmt == "q4k" and q4k_compatible(out_dim, in_dim, for_tpu=True):
+            qs = jax.random.randint(k, (L, out_dim, in_dim // 2),
+                                    -128, 128, jnp.int8)
+            sm = jnp.full((L, in_dim // TK, out_dim, 128),
+                          (in_dim ** -0.5) / 8.0, jnp.bfloat16)
+            return {"qs": qs, "sm": sm}
         q = jax.random.randint(k, (L, out_dim, in_dim), -127, 128, jnp.int8)
         s = jnp.full((L, out_dim), (in_dim ** -0.5) / 127.0, jnp.float32)
         return {"q": q, "s": s}
@@ -90,15 +104,27 @@ def synth_int8_device(cfg: ModelConfig, seed: int = 0) -> dict:
             "w_down": lin(ks[7], cfg.dim, cfg.ffn_dim),
         },
         "out_norm": jnp.ones(cfg.dim, jnp.float32),
-        "output": {
-            "q": jax.random.randint(ks[0], (cfg.vocab_size, cfg.dim), -127, 128, jnp.int8),
-            "s": jnp.full((cfg.vocab_size,), (cfg.dim ** -0.5) / 127.0, jnp.float32),
-        },
+        "output": (
+            {
+                "qs": jax.random.randint(ks[0], (cfg.vocab_size, cfg.dim // 2),
+                                         -128, 128, jnp.int8),
+                "sm": jnp.full((cfg.dim // TK, cfg.vocab_size, 128),
+                               (cfg.dim ** -0.5) / 8.0, jnp.bfloat16),
+            }
+            if fmt == "q4k" and q4k_compatible(cfg.vocab_size, cfg.dim, for_tpu=True)
+            else {
+                "q": jax.random.randint(ks[0], (cfg.vocab_size, cfg.dim),
+                                        -127, 128, jnp.int8),
+                "s": jnp.full((cfg.vocab_size,), (cfg.dim ** -0.5) / 127.0,
+                              jnp.float32),
+            }
+        ),
     }
 
 
 def main():
     preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
+    wfmt = os.environ.get("LFKT_BENCH_FMT", "int8")  # int8 | q4k
     cfg = TINY if preset == "tiny" else LLAMA3_8B
     prompt_len = 128
     gen_tokens = int(os.environ.get("LFKT_BENCH_TOKENS", "256" if preset != "tiny" else "32"))
@@ -106,7 +132,12 @@ def main():
 
     dev = jax.devices()[0]
     t0 = time.time()
-    params = synth_int8_device(cfg)
+    params = synth_int8_device(cfg, fmt=wfmt)
+    # label honesty: report q4k only if any tensor actually got the layout
+    if wfmt == "q4k" and not any(
+            isinstance(v, dict) and "qs" in v
+            for v in [*params["layers"].values(), params["output"]]):
+        wfmt = "int8"
     # sync: reduce EVERY leaf to a scalar and fetch it (block_until_ready is
     # unreliable on the tunneled platform; partial fetches leak into compile_s)
     float(sum(x.sum().astype(jnp.float32)
@@ -155,7 +186,7 @@ def main():
     tok_s = (n_chunks * chunk) / decode_s
 
     result = {
-        "metric": f"decode_tokens_per_sec_per_chip[{preset},int8,synthetic]",
+        "metric": f"decode_tokens_per_sec_per_chip[{preset},{wfmt},synthetic]",
         "value": round(tok_s, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tok_s / A10G_Q4KM_8B_TOK_S, 3),

@@ -97,9 +97,13 @@ class Engine:
             self.tokenizer = tokenizer_from_gguf(gf)
             if weight_format == "auto":
                 # bf16 params ≈ 2 bytes/weight; pick int8 when a bf16 copy
-                # would crowd a 16 GB v5e HBM (≳ 4 GB of linear weights)
+                # would crowd a 16 GB v5e HBM (≳ 4 GB of linear weights).
+                # "q4k" (fused Pallas kernel, ~5 bit/weight) is opt-in via
+                # LFKT_WEIGHT_FORMAT until it beats int8 on-chip — measured
+                # 2026-07: the kernel is currently dequant-bound, not
+                # bandwidth-bound, and loses to int8 on decode.
                 n_lin = self.cfg.n_layers * (
-                    4 * self.cfg.dim * self.cfg.dim // 1  # attn (approx)
+                    4 * self.cfg.dim * self.cfg.dim
                     + 3 * self.cfg.dim * self.cfg.ffn_dim
                 )
                 weight_format = "int8" if n_lin * 2 > 4e9 else "bf16"

@@ -9,8 +9,14 @@ Formats (``ops.linear``):
 - ``bf16`` — exact dequant, 2 B/weight.  16 GB for Llama-3-8B: does NOT fit
   one v5e chip; use for small models and CPU tests.
 - ``int8`` — symmetric per-channel requant of the dequantized weights,
-  1 B/weight (~8.5 GB for 8B incl. bf16 embeddings): the v5e serving format
-  until the fused-Q4_K Pallas path lands.
+  1 B/weight (~8.5 GB for 8B incl. bf16 embeddings).
+- ``q4k`` — Q4_K tensors stay in (nearly) their GGUF bit layout in HBM
+  (~5 bit/weight) and are dequantized in-VMEM by the fused Pallas matmul
+  (ops/pallas/qmatmul.py); non-Q4_K tensors fall back to int8.  The v5e
+  serving format: lowest decode HBM traffic.  Because per-layer tensors are
+  stacked for ``lax.scan``, the format choice is made per tensor *name*:
+  a name uses q4k only if every layer's tensor of that name is Q4_K with
+  kernel-compatible shapes (Q4_K_M files mix in Q6_K for some layers).
 
 GGUF tensor names follow llama.cpp's convention: ``token_embd.weight``,
 ``blk.{i}.attn_{q,k,v,output}.weight``, ``blk.{i}.ffn_{gate,up,down}.weight``,
@@ -67,12 +73,41 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     """
     if on_device is None:
         on_device = jax.default_backend() == "tpu"
-    make = _LINEAR_MAKERS[fmt]
+    base_fmt = "int8" if fmt == "q4k" else fmt
+    make = _LINEAR_MAKERS[base_fmt]
+
+    def _q4k_names() -> set[str]:
+        """Linear positions where ALL layers are fused-kernel-eligible."""
+        from ..gguf.constants import GGMLType
+        from ..ops.pallas.qmatmul import q4k_compatible
+
+        names = ["attn_q", "attn_k", "attn_v", "attn_output",
+                 "ffn_gate", "ffn_up", "ffn_down"]
+        ok = set()
+        for n in names:
+            ts = [gf[f"blk.{i}.{n}.weight"] for i in range(cfg.n_layers)]
+            if all(t.ggml_type == GGMLType.Q4_K
+                   and q4k_compatible(*reversed(t.shape)) for t in ts):
+                ok.add(n)
+        t = gf.tensors.get("output.weight")
+        if t is not None and t.ggml_type == GGMLType.Q4_K \
+                and q4k_compatible(*reversed(t.shape)):
+            ok.add("output")
+        return ok
+
+    q4k_names = _q4k_names() if fmt == "q4k" else set()
 
     def lin(name: str) -> dict:
+        short = name.split(".")[-2] if name.startswith("blk.") else name.split(".")[0]
+        if short in q4k_names:
+            from ..ops.pallas.qmatmul import prep_q4k
+
+            t = gf[name]
+            n_out, k_in = tuple(reversed(t.shape))
+            return prep_q4k(np.asarray(t.raw()), n_out, k_in)
         if on_device:
             w = _tensor_to_device(gf[name])
-            if fmt == "int8":
+            if base_fmt == "int8":
                 return make_linear_int8_device(w)
             return {"w": w.astype(jnp.bfloat16)}
         return make(gf[name].astype_f32())
@@ -120,12 +155,19 @@ def synth_params(cfg: ModelConfig, fmt: str = "bf16", seed: int = 0,
     egress (BASELINE.md: bench models are synthesized, not downloaded).
     """
     rng = np.random.default_rng(seed)
-    make = _LINEAR_MAKERS[fmt]
+    make = _LINEAR_MAKERS["int8" if fmt == "q4k" else fmt]
     if scale is None:
         scale = cfg.dim ** -0.5
 
     def lin(out_dim, in_dim):
-        return make(rng.standard_normal((out_dim, in_dim), dtype=np.float32) * scale)
+        w = rng.standard_normal((out_dim, in_dim), dtype=np.float32) * scale
+        if fmt == "q4k":
+            from ..ops import make_linear_q4k
+            from ..ops.pallas.qmatmul import q4k_compatible
+
+            if q4k_compatible(out_dim, in_dim):
+                return make_linear_q4k(w)
+        return make(w)
 
     kv_dim = cfg.n_kv_heads * cfg.head_dim
     layers = []

@@ -3,4 +3,5 @@ from .linear import (  # noqa: F401
     make_linear_bf16,
     make_linear_int8,
     make_linear_int8_device,
+    make_linear_q4k,
 )

@@ -47,8 +47,24 @@ def make_linear_int8_device(w: jax.Array) -> dict:
     return {"q": q, "s": scale}
 
 
+def make_linear_q4k(w: np.ndarray) -> dict:
+    """(out, in) float weights → fused-kernel Q4_K layout (quantize with the
+    in-tree codec, then pack for ops/pallas/qmatmul.py).  ~5 bit/weight in
+    HBM; the decode-bandwidth format."""
+    from ..gguf.quants import quant_q4_k
+    from .pallas.qmatmul import prep_q4k
+
+    w = np.ascontiguousarray(w, dtype=np.float32)
+    n_out, k_in = w.shape
+    return prep_q4k(quant_q4_k(w.reshape(-1)), n_out, k_in)
+
+
 def linear(x: jax.Array, w: dict) -> jax.Array:
     """x: (..., in) bf16 → (..., out) bf16."""
+    if "qs" in w:
+        from .pallas.qmatmul import q4k_matmul
+
+        return q4k_matmul(x, w)
     if "w" in w:
         return jax.lax.dot_general(
             x, w["w"],

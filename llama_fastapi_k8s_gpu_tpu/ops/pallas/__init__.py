@@ -43,6 +43,7 @@ from .dequant import (  # noqa: E402
     dequant_q6_k_device,
     dequant_q8_0_device,
 )
+from .qmatmul import prep_q4k, q4k_matmul  # noqa: E402
 
 __all__ = [
     "flash_attention",
@@ -51,6 +52,8 @@ __all__ = [
     "dequant_q5_k_device",
     "dequant_q6_k_device",
     "dequant_q8_0_device",
+    "prep_q4k",
+    "q4k_matmul",
     "force_interpret",
     "use_interpret",
 ]

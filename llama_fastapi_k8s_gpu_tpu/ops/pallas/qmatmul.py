@@ -1,0 +1,232 @@
+"""Fused Q4_K dequant-matmul (Pallas): decode directly from ~5-bit weights.
+
+The decode hot loop is HBM-bandwidth-bound: every generated token reads every
+weight byte once (SURVEY.md §6; the reference's llama.cpp engine solves this
+on GPU with fused dequant-matmul CUDA kernels inside llama-cpp-python,
+reference docker/Dockerfile.base:30-32).  The int8 path (ops/linear.py)
+already halves traffic vs bf16; this kernel goes further by keeping the
+weights in (almost) their GGUF Q4_K form in HBM:
+
+- packed 4-bit nibbles, exactly as laid out in the file   → 4.00 bit/weight
+- folded per-sub-block scale/min in bf16 (d·sc, dmin·mn)  → 1.00 bit/weight
+                                                      total ≈ 5 bit/weight
+
+i.e. ~0.62× the int8 bytes/token, which on a bandwidth-bound decode is a
+~1.6× throughput ceiling raise.  Tiles are dequantized into VMEM only, fed
+straight to the MXU, and never written back to HBM.
+
+Layout contract (produced by :func:`prep_q4k` from raw GGUF block bytes; bit
+layouts follow gguf/quants.py, the numpy oracle).  The K axis is processed
+in fixed tiles of ``TK = 2048`` elements = 8 Q4_K super-blocks:
+
+- ``qs`` (N, K/2) int8 — packed nibbles in file byte order; super-block ``b``
+  of a row occupies columns [128b, 128(b+1)); byte ``g*32+i`` holds
+  sub-block ``2g`` element ``i`` in its low nibble and sub-block ``2g+1``
+  element ``i`` in its high nibble.
+- ``sm`` (K/2048, N, 128) bf16 — per k-tile: 64 effective scales (d·sc)
+  then 64 effective mins (dmin·mn), one per 32-element sub-block, ordered
+  block-major with each block's 8 sub-blocks in **even/odd order**
+  [s0,s2,s4,s6, s1,s3,s5,s7] — so after the kernel unpacks nibbles as
+  [all-lo | all-hi] per block, output column ``j``'s sub-block is ``j//32``.
+  Merging scales+mins into one 128-lane array keeps every Pallas block
+  shape on Mosaic's (8, 128) tiling grid.
+
+Activations are pre-permuted to the same order by :func:`permute_x`
+(even sub-blocks of each 256-block first, then odd) — a cheap XLA reshape
+fused into the surrounding graph.
+
+Shape requirements: ``K % 2048 == 0`` and ``N % 128 == 0`` (all Llama-3 /
+Mistral linear shapes qualify; loaders fall back to the int8 format
+otherwise — see models/params.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
+from ...gguf.quants import unpack_scale_min_k4
+
+TK = 2048            # K elements per kernel step = 8 super-blocks
+_SUBS = TK // 32     # 64 sub-blocks per k-tile
+
+
+def _interpret(override: bool | None) -> bool:
+    if override is not None:
+        return override
+    from . import use_interpret
+
+    return use_interpret()
+
+
+def q4k_compatible(n_out: int, k_in: int, for_tpu: bool | None = None) -> bool:
+    """Whether (n_out, k_in) can use the fused kernel.  On TPU, N must tile
+    to 128 sublanes; interpret mode (CPU tests) accepts any multiple of 8."""
+    if for_tpu is None:
+        for_tpu = not _interpret(None)
+    return k_in % TK == 0 and n_out % (128 if for_tpu else 8) == 0
+
+
+# ---------------------------------------------------------------------------
+# host-side weight prep
+# ---------------------------------------------------------------------------
+
+def prep_q4k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
+    """Raw Q4_K block bytes (row-major, ``n_out`` rows of ``k_in`` elements)
+    → the kernel layout dict {"qs", "sm"}."""
+    if not q4k_compatible(n_out, k_in):
+        raise ValueError(f"({n_out}, {k_in}) not fused-Q4_K compatible "
+                         f"(need K%{TK}==0, N%128==0)")
+    bs = GGML_BLOCK_SIZES[GGMLType.Q4_K][1]           # 144
+    nb = k_in // QK_K
+    blocks = np.ascontiguousarray(raw, dtype=np.uint8)[: n_out * nb * bs]
+    blocks = blocks.reshape(n_out, nb, bs)
+    d = blocks[..., 0:2].copy().view(np.float16).astype(np.float32)[..., 0]
+    dmin = blocks[..., 2:4].copy().view(np.float16).astype(np.float32)[..., 0]
+    sc, mn = unpack_scale_min_k4(blocks[..., 4:16])   # (N, nb, 8) uint8
+    eff_s = d[..., None] * sc.astype(np.float32)      # (N, nb, 8)
+    eff_m = dmin[..., None] * mn.astype(np.float32)
+    # even/odd sub-block order to match the kernel's [lo | hi] unpack
+    eo = np.concatenate([eff_s[..., 0::2], eff_s[..., 1::2]], axis=-1)
+    mo = np.concatenate([eff_m[..., 0::2], eff_m[..., 1::2]], axis=-1)
+    ktiles = k_in // TK
+    eo = eo.reshape(n_out, ktiles, _SUBS)             # 8 blocks × 8 subs
+    mo = mo.reshape(n_out, ktiles, _SUBS)
+    sm = np.concatenate([eo, mo], axis=-1)            # (N, ktiles, 128)
+    sm = np.ascontiguousarray(sm.transpose(1, 0, 2))  # (ktiles, N, 128)
+    qs = blocks[..., 16:].reshape(n_out, nb * 128).view(np.int8)
+    return {
+        "qs": jnp.asarray(qs),
+        "sm": jnp.asarray(sm, dtype=jnp.bfloat16),
+    }
+
+
+def permute_x(x: jax.Array) -> jax.Array:
+    """(..., K) → (..., K) with each 256-block reordered to even/odd
+    sub-block order (the layout :func:`prep_q4k` stores scales in)."""
+    K = x.shape[-1]
+    xb = x.reshape(*x.shape[:-1], K // QK_K, 8, 32)
+    xe = jnp.concatenate([xb[..., 0::2, :], xb[..., 1::2, :]], axis=-2)
+    return xe.reshape(*x.shape[:-1], K)
+
+
+def dequant_ref(w: dict) -> jax.Array:
+    """(N, K) f32 dequantized weights in **permuted** column order — the
+    small-shape oracle the kernel is tested against."""
+    N, half = w["qs"].shape
+    nb = half // 128
+    qs = w["qs"].astype(jnp.int32)
+    lo = (qs & 0x0F).reshape(N, nb, 128)
+    hi = ((qs >> 4) & 0x0F).reshape(N, nb, 128)
+    q = jnp.concatenate([lo, hi], axis=2).reshape(N, nb * 256).astype(jnp.float32)
+    sm = jnp.transpose(w["sm"], (1, 0, 2)).astype(jnp.float32)  # (N, kt, 128)
+    sc = sm[..., :_SUBS].reshape(N, -1)               # (N, K/32)
+    mn = sm[..., _SUBS:].reshape(N, -1)
+    sub = jax.lax.broadcasted_iota(jnp.int32, q.shape, 1) // 32
+    sc = jnp.take_along_axis(sc, sub, axis=1)
+    mn = jnp.take_along_axis(mn, sub, axis=1)
+    return q * sc - mn
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _q4k_matmul_kernel(xp_ref, qs_ref, sm_ref, o_ref):
+    # xp (B, TK) bf16 permuted; qs (TN, TK/2) int8; sm (1, TN, 128) bf16
+    qs = qs_ref[...].astype(jnp.int32)
+    TN = qs.shape[0]
+    nb = TK // QK_K                                   # 8 super-blocks
+    lo = (qs & 0x0F).reshape(TN, nb, 128)
+    hi = ((qs >> 4) & 0x0F).reshape(TN, nb, 128)
+    q = jnp.concatenate([lo, hi], axis=2).reshape(TN, TK).astype(jnp.float32)
+
+    sm = sm_ref[...].reshape(TN, 128)
+    sc = sm[:, :_SUBS]                                # (TN, 64) bf16
+    mn = sm[:, _SUBS:]
+
+    # expand per-sub-block scale/min over their 32 lanes with a 0/1 matmul
+    # (MXU-friendly; avoids unsupported small-minor-dim reshapes)
+    s_idx = jax.lax.broadcasted_iota(jnp.int32, (_SUBS, TK), 0)
+    col_sub = jax.lax.broadcasted_iota(jnp.int32, (_SUBS, TK), 1) // 32
+    expand = (s_idx == col_sub).astype(jnp.bfloat16)  # (64, TK)
+    sc_exp = jax.lax.dot_general(
+        sc, expand, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (TN, TK)
+    mn_exp = jax.lax.dot_general(
+        mn, expand, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    a = (q * sc_exp - mn_exp).astype(jnp.bfloat16)    # dequantized tile (VMEM)
+    partial = jax.lax.dot_general(
+        xp_ref[...], a, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (B, TN)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] += partial
+
+
+def _pick_tn(n: int, interpret: bool) -> int:
+    for c in (256, 128) + ((64, 32, 16, 8) if interpret else ()):
+        if n % c == 0:
+            return c
+    raise ValueError(f"N={n} not divisible by 128")
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _q4k_matmul_2d(xp: jax.Array, qs: jax.Array, sm: jax.Array,
+                   interpret: bool = False) -> jax.Array:
+    B, K = xp.shape
+    N = qs.shape[0]
+    TN = _pick_tn(N, interpret)
+    grid = (N // TN, K // TK)
+    return pl.pallas_call(
+        _q4k_matmul_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B, TK), lambda n, k: (0, k)),
+            pl.BlockSpec((TN, TK // 2), lambda n, k: (n, k)),
+            pl.BlockSpec((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, TN), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
+        interpret=interpret,
+    )(xp, qs, sm)
+
+
+_MAX_B = 128  # rows per kernel call: bounds the xp/out VMEM blocks (the
+              # weight tiles dominate; a (128, 2048) bf16 xp block is 512 KiB)
+
+
+def q4k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:
+    """x (..., K) bf16/f32 → (..., N) in x.dtype, weights in Q4_K kernel
+    layout (see module docstring).  The fused path of ``ops.linear.linear``.
+
+    Large batch/sequence dims (prefill buckets) are processed in row chunks
+    of ``_MAX_B`` so VMEM blocks stay bounded."""
+    K = x.shape[-1]
+    lead = x.shape[:-1]
+    xp = permute_x(x).reshape(-1, K).astype(jnp.bfloat16)
+    itp = _interpret(interpret)
+    B = xp.shape[0]
+    if B <= _MAX_B:
+        y = _q4k_matmul_2d(xp, w["qs"], w["sm"], interpret=itp)
+    else:
+        pad = (-B) % _MAX_B
+        if pad:
+            xp = jnp.concatenate(
+                [xp, jnp.zeros((pad, K), xp.dtype)], axis=0)
+        chunks = [
+            _q4k_matmul_2d(xp[i:i + _MAX_B], w["qs"], w["sm"], interpret=itp)
+            for i in range(0, B + pad, _MAX_B)
+        ]
+        y = jnp.concatenate(chunks, axis=0)[:B]
+    return y.reshape(*lead, -1).astype(x.dtype)

@@ -47,18 +47,27 @@ def _ns(mesh: Mesh, *spec) -> NamedSharding:
 
 
 def _linear_sharding(mesh: Mesh, col_parallel: bool) -> dict:
-    """Sharding for a stacked linear {'w': (L,out,in)} or {'q','s'}.
+    """Sharding for a stacked linear {'w': (L,out,in)}, {'q','s'} (int8), or
+    {'qs','sm'} (fused Q4_K; qs (L,out,in/2), sm (L,in/2048,out,128)).
 
     Column-parallel (wq/wk/wv/w_gate/w_up): shard the output dim.
     Row-parallel (wo/w_down): shard the input dim; XLA inserts the psum.
+    (The fused-Q4_K pallas_call has no GSPMD partitioning rule yet, so a
+    sharded qs is all-gathered at the call — correct, not yet ICI-optimal.)
     """
     if col_parallel:
         return {"w": _ns(mesh, None, "tp", None),
                 "q": _ns(mesh, None, "tp", None),
-                "s": _ns(mesh, None, "tp")}
+                "s": _ns(mesh, None, "tp"),
+                "qs": _ns(mesh, None, "tp", None),
+                "sm": _ns(mesh, None, None, "tp", None)}
     return {"w": _ns(mesh, None, None, "tp"),
             "q": _ns(mesh, None, None, "tp"),
-            "s": _ns(mesh, None, None)}
+            "s": _ns(mesh, None, None),
+            "qs": _ns(mesh, None, None, "tp"),
+            # sm's k-tile count (K/2048, e.g. 7 for ffn_down) need not divide
+            # tp; replicate — it is only 1 bit/weight of the total
+            "sm": _ns(mesh, None, None, None, None)}
 
 
 def _match_linear(shardings: dict, linear: dict) -> dict:
@@ -79,8 +88,10 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
         else:  # wo, w_down
             layer_shard[name] = _match_linear(row, leaf)
     out = params["output"]
-    out_shard = {k: (_ns(mesh, "tp", None) if k in ("w", "q") else _ns(mesh, "tp"))
-                 for k in out}
+    head = {"w": _ns(mesh, "tp", None), "q": _ns(mesh, "tp", None),
+            "s": _ns(mesh, "tp"), "qs": _ns(mesh, "tp", None),
+            "sm": _ns(mesh, None, "tp", None)}
+    out_shard = {k: head[k] for k in out}
     return {
         "tok_emb": _ns(mesh, None, None),      # replicated (gather-heavy)
         "layers": layer_shard,
