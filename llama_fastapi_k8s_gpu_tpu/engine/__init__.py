@@ -1,2 +1,3 @@
+from .batched import MeshEngine  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .fake import FakeEngine  # noqa: F401

@@ -1,0 +1,263 @@
+"""Mesh-batched engine: B concurrent chat completions in one program.
+
+The reference scales concurrent load with 4 shared-nothing single-GPU pods
+behind a k8s Service (reference helm/values.yaml:17; SURVEY.md §2A
+"Parallelism strategies") — each pod still generates strictly serially
+(Semaphore(1), reference api.py:114).  The TPU-native equivalent for the
+"concurrent /response load on v5e-4" config (BASELINE.json) batches
+requests *inside* one process instead: requests coalesce into a batch of B
+sequences, vmap-lifted over the model (parallel/batched.py) and laid out on
+a dp×tp ``jax.sharding.Mesh`` — the batch dim shards over ``dp`` chips, the
+model over ``tp``, XLA inserts the ICI collectives.
+
+Decode efficiency is the point: a single-sequence decode matvec cannot
+saturate HBM/MXU; batching B requests multiplies decode throughput at
+nearly constant step latency (weights are read once per step regardless of
+B).  FIFO admission order is preserved by the server's consumer, which
+drains up to B queued requests per cycle (server/app.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+import uuid
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.batched import (
+    batched_generate_chunk_jit,
+    batched_prefill_jit,
+    init_batched_state,
+)
+from ..parallel.mesh import make_mesh, shard_params, state_shardings
+from ..sampling.sample import (
+    PENALTY_WINDOW,
+    SamplingParams,
+    sample_chain,
+    sampling_tensors,
+    seed_window,
+)
+from .engine import Engine
+
+logger = logging.getLogger(__name__)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def _batched_first_sample(logits, windows, wposes, keys, st, top_k=40):
+    """Sample the first token of every sequence from prefill logits."""
+
+    def single(lg, window, wpos, key):
+        key, sub = jax.random.split(key)
+        tok = sample_chain(lg, window, sub, st, top_k=top_k)
+        window = window.at[wpos % PENALTY_WINDOW].set(tok)
+        return tok, window, wpos + 1, key
+
+    return jax.vmap(single)(logits, windows, wposes, keys)
+
+
+class MeshEngine(Engine):
+    """An :class:`Engine` that serves batches of requests over a device mesh.
+
+    ``create_chat_completion`` still works (batch of one).  The batch entry
+    point is :meth:`create_chat_completions`, which the server's consumer
+    feeds with up-to-``batch_size`` queued requests at a time.
+    """
+
+    def __init__(self, model_path: str | None, *, dp: int | None = None,
+                 tp: int = 1, batch_size: int | None = None, **kw):
+        super().__init__(model_path, **kw)
+        avail = max(1, len(jax.devices()) // tp)
+        if dp is None:
+            if batch_size is None:
+                dp = avail
+            else:  # largest device count the batch shards evenly over
+                dp = max(d for d in range(1, avail + 1) if batch_size % d == 0)
+        self.mesh = make_mesh(dp=dp, tp=tp)
+        self.batch_size = batch_size or dp
+        if self.batch_size % dp:
+            raise ValueError(
+                f"batch_size {self.batch_size} must be divisible by dp={dp}")
+        self.params = shard_params(self.params, self.mesh)
+        state = init_batched_state(self.cfg, self.batch_size)
+        self._bstate = jax.device_put(
+            state, state_shardings(self.cfg, self.mesh, batched=True))
+
+    # ------------------------------------------------------------------
+    def warmup(self):  # compile the batched shapes instead of the serial ones
+        t0 = time.time()
+        msgs = [{"role": "user", "content": "hi"}]
+        self.create_chat_completions([msgs] * self.batch_size,
+                                     max_tokens=self.decode_chunk + 1,
+                                     temperature=0.0)
+        logger.info("mesh warmup done in %.1fs (dp=%d tp=%d batch=%d)",
+                    time.time() - t0, self.mesh.shape["dp"],
+                    self.mesh.shape["tp"], self.batch_size)
+
+    # ------------------------------------------------------------------
+    def create_chat_completions(
+        self,
+        batch_messages: Sequence[Sequence[dict]],
+        *,
+        temperature: float = 0.2,
+        top_p: float = 0.95,
+        top_k: int = 40,
+        min_p: float = 0.05,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
+        repeat_penalty: float = 1.1,
+        max_tokens: int | None = None,
+        stop: Sequence[str] | str | None = None,
+        seed: int | None = None,
+    ) -> list[dict]:
+        """Generate up to ``batch_size`` completions in one batched program.
+        Returns one OpenAI-shaped dict per input, in order."""
+        if not batch_messages:
+            return []
+        if len(batch_messages) > self.batch_size:
+            raise ValueError(
+                f"batch of {len(batch_messages)} exceeds batch_size {self.batch_size}")
+        if stop is None:
+            stop = []
+        elif isinstance(stop, str):
+            stop = [stop]
+        sp = SamplingParams(
+            temperature=temperature, top_p=top_p, top_k=top_k, min_p=min_p,
+            frequency_penalty=frequency_penalty, presence_penalty=presence_penalty,
+            repeat_penalty=repeat_penalty,
+        )
+        with self._lock:
+            return self._generate_batch(list(batch_messages), sp, max_tokens,
+                                        stop, seed)
+
+    # ------------------------------------------------------------------
+    def _generate_batch(self, batch_messages, sp, max_tokens, stops, seed):
+        B = self.batch_size
+        n_real = len(batch_messages)
+        dummy = [self.tokenizer.bos_id or 0]
+        # An oversized prompt is that request's own input error — it must not
+        # fail its batch neighbors (reference semantics are per-request,
+        # api.py:76-78).  Replace it with a dummy slot and report per-entry.
+        ids_list, errors = [], {}
+        for i, m in enumerate(batch_messages):
+            ids = self.tokenize_messages(m)
+            if len(ids) >= self.cfg.n_ctx:
+                errors[i] = (f"Requested tokens ({len(ids)}) exceed context "
+                             f"window of {self.cfg.n_ctx}")
+                ids = dummy
+            ids_list.append(ids)
+        # pad the batch with a minimal dummy prompt (static batch shape)
+        ids_list += [dummy] * (B - n_real)
+        if seed is None:
+            seed = self._base_seed + self._requests
+        self._requests += n_real
+
+        bucket = self._bucket_for(max(len(i) for i in ids_list))
+        lengths = jnp.asarray([len(i) for i in ids_list], jnp.int32)
+        tokens = jnp.asarray(
+            [i + [0] * (bucket - len(i)) for i in ids_list], jnp.int32)
+        st = sampling_tensors(sp)
+
+        t0 = time.time()
+        state = self._bstate
+        logits, caches = batched_prefill_jit(
+            self.params, self.cfg, tokens, lengths, state["cache"])
+        windows, wposes = zip(*(seed_window(i) for i in ids_list))
+        keys = jax.random.split(jax.random.PRNGKey(seed), B)
+        toks, windows, wposes, keys = _batched_first_sample(
+            logits, jnp.stack(windows), jnp.stack(wposes), keys, st,
+            top_k=sp.top_k)
+        state = {
+            "cache": caches, "pos": lengths, "token": toks,
+            "window": windows, "wpos": wposes, "key": keys,
+        }
+        first = np.asarray(toks).tolist()  # host sync: TTFT for the batch
+        ttft = time.time() - t0
+
+        stop_ids = self.tokenizer.stop_ids
+        budgets = [self._token_budget(max_tokens, len(i)) for i in ids_list]
+        gens: list[list[int]] = []
+        done = [False] * B
+        finishes = ["length"] * B                     # same default as Engine._run
+        for b, tok in enumerate(first):
+            if b >= n_real or b in errors or budgets[b] <= 0:
+                gens.append([])
+                done[b] = True
+            elif tok in stop_ids:
+                gens.append([])
+                done[b] = True
+                finishes[b] = "stop"
+            else:
+                gens.append([tok])
+        max_pos = int(np.max(np.asarray(lengths))) + 1
+
+        while not all(done):
+            remaining = max(budgets[b] - len(gens[b]) for b in range(B) if not done[b])
+            n_steps = min(self.decode_chunk, remaining,
+                          self.cfg.n_ctx - max_pos - 1)
+            if n_steps <= 0:
+                break                                 # context window: "length"
+            state, toks = batched_generate_chunk_jit(
+                self.params, self.cfg, state, st,
+                n_steps=n_steps, top_k=sp.top_k)
+            max_pos += n_steps
+            chunk = np.asarray(toks)                  # (n_steps, B) host sync
+            for b in range(B):
+                if done[b]:
+                    continue
+                for t in chunk[:, b].tolist():
+                    if t in stop_ids:
+                        done[b] = True
+                        finishes[b] = "stop"
+                        break
+                    if len(gens[b]) >= budgets[b]:
+                        done[b] = True
+                        break
+                    gens[b].append(t)
+                if len(gens[b]) >= budgets[b]:
+                    done[b] = True
+
+        self._bstate = state                          # reuse buffers
+        decode_s = time.time() - t0 - ttft
+        total_new = sum(len(g) for g in gens[:n_real])
+        self.last_timings = {
+            "ttft_s": ttft, "decode_s": decode_s,
+            "prompt_tokens": int(sum(len(i) for i in ids_list[:n_real])),
+            "completion_tokens": total_new,
+            "tokens_per_sec": (total_new - n_real) / decode_s
+            if decode_s > 0 and total_new > n_real else 0.0,
+        }
+
+        out = []
+        for b in range(n_real):
+            if b in errors:
+                out.append({"error": {"message": errors[b],
+                                      "type": "invalid_request_error"}})
+                continue
+            text = self._decode_text(gens[b])
+            cut = self._find_stop_str(text, stops)
+            finish = finishes[b]
+            if cut != -1:
+                text = text[:cut]
+                finish = "stop"
+            out.append({
+                "id": f"chatcmpl-{uuid.uuid4().hex}",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": self.model_name,
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish,
+                }],
+                "usage": {
+                    "prompt_tokens": len(ids_list[b]),
+                    "completion_tokens": len(gens[b]),
+                    "total_tokens": len(ids_list[b]) + len(gens[b]),
+                },
+            })
+        return out

@@ -127,8 +127,30 @@ def state_shardings(cfg: ModelConfig, mesh: Mesh, batched: bool = False) -> dict
     }
 
 
+def _fit_sharding(arr, ns: NamedSharding) -> NamedSharding:
+    """Drop spec axes an array can't honor (dim not divisible by the mesh
+    axis) — e.g. tiny test vocabularies vs a tp-sharded LM head.  Real model
+    dims divide evenly and keep the full spec."""
+    mesh = ns.mesh
+    spec = list(ns.spec) + [None] * (arr.ndim - len(ns.spec))
+    fixed = []
+    for dim, axes in zip(arr.shape, spec):
+        if axes is None:
+            fixed.append(None)
+            continue
+        names = (axes,) if isinstance(axes, str) else tuple(axes)
+        size = int(np.prod([mesh.shape[a] for a in names]))
+        fixed.append(axes if dim % size == 0 else None)
+    return NamedSharding(mesh, P(*fixed))
+
+
+def fit_shardings(params: dict, shardings: dict) -> dict:
+    return jax.tree.map(_fit_sharding, params, shardings)
+
+
 def shard_params(params: dict, mesh: Mesh) -> dict:
-    return jax.device_put(params, param_shardings(params, mesh))
+    return jax.device_put(
+        params, fit_shardings(params, param_shardings(params, mesh)))
 
 
 def shard_cache(cache: dict, cfg: ModelConfig, mesh: Mesh, batched: bool = False) -> dict:

@@ -91,33 +91,83 @@ def create_app(engine=None, settings: Settings | None = None,
     app.state.ready = engine is not None
 
     async def consumer():
-        """Single drain task: strict FIFO, one generation at a time
-        (reference api.py:80-107)."""
+        """Single drain task: strict FIFO, one generation *cycle* at a time
+        (reference api.py:80-107).  With ``batch_size > 1`` and a
+        batch-capable engine, a cycle coalesces up to batch_size queued
+        requests into one mesh-batched generation (engine/batched.py);
+        FIFO order is preserved."""
         queue = app.state.queue
         semaphore = app.state.semaphore
         while True:
-            request_data = await queue.get()
-            messages = request_data["messages"]
-            future = request_data["future"]
-            app.state.metrics.observe(
-                "queue_wait_seconds", time.time() - request_data["enqueued_at"])
-            if future.cancelled():
-                logger.info("Future was cancelled before processing; skipping.")
-                queue.task_done()
-                continue
-            try:
-                response = await _truncate_and_generate(messages, semaphore)
-                if not future.cancelled():
-                    future.set_result(response)
+            batch = [await queue.get()]
+            can_batch = (settings.batch_size > 1
+                         and hasattr(app.state.engine, "create_chat_completions"))
+            while can_batch and len(batch) < settings.batch_size:
+                try:
+                    batch.append(queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            now = time.time()
+            live = []
+            for rd in batch:
+                app.state.metrics.observe(
+                    "queue_wait_seconds", now - rd["enqueued_at"])
+                if rd["future"].cancelled():
+                    logger.info("Future was cancelled before processing; skipping.")
                 else:
-                    logger.info("Future cancelled during processing; result dropped.")
-            except Exception as e:  # noqa: BLE001 — must never kill the consumer
-                if not future.cancelled():
-                    future.set_exception(e)
+                    live.append(rd)
+            results: list[tuple] = []           # (request, response, error)
+            if can_batch and live:
+                # batch-of-one included: MeshEngine.warmup compiles only the
+                # batched shapes, so even solo requests must use them
+                try:
+                    responses = await _truncate_and_generate_batch(
+                        [rd["messages"] for rd in live], semaphore)
+                    results = [
+                        (rd, None, r) if isinstance(r, Exception) else (rd, r, None)
+                        for rd, r in zip(live, responses)
+                    ]
+                except Exception as e:  # noqa: BLE001 — one program, one failure
+                    results = [(rd, None, e) for rd in live]
+            else:
+                for rd in live:     # per-request isolation (reference semantics)
+                    try:
+                        results.append((rd, await _truncate_and_generate(
+                            rd["messages"], semaphore), None))
+                    except Exception as e:  # noqa: BLE001
+                        results.append((rd, None, e))
+            for rd, resp, err in results:
+                if rd["future"].cancelled():
+                    logger.info("Future cancelled during processing; "
+                                "%s dropped.", "error" if err else "result")
+                elif err is not None:
+                    rd["future"].set_exception(err)
                 else:
-                    logger.info("Future cancelled during processing; error dropped.")
-            finally:
+                    rd["future"].set_result(resp)
+            for _ in batch:
                 queue.task_done()
+
+    def _observe_engine_timings(m):
+        timings = getattr(app.state.engine, "last_timings", None)
+        if timings:
+            m.observe("engine_ttft_seconds", timings["ttft_s"])
+            if timings["tokens_per_sec"]:
+                m.observe("engine_decode_tokens_per_sec",
+                          timings["tokens_per_sec"])
+
+    def _answer_to_text(answer, m) -> str:
+        """OpenAI-shaped dict → concatenated choice text (reference
+        api.py:65-74 semantics, incl. the dict typecheck → 500)."""
+        if not isinstance(answer, dict):
+            logger.error("Unexpected response type: %s. Response: %s",
+                         type(answer), answer)
+            raise HTTPException(status_code=500,
+                                detail="Unexpected response from model")
+        usage = answer.get("usage") or {}
+        if usage.get("completion_tokens"):
+            m.inc("generated_tokens_total", usage["completion_tokens"])
+        return "".join(c["message"]["content"]
+                       for c in answer.get("choices", []) if "message" in c)
 
     async def _truncate_and_generate(messages, semaphore) -> str:
         m = app.state.metrics
@@ -136,29 +186,56 @@ def create_app(engine=None, settings: Settings | None = None,
                     presence_penalty=settings.presence_penalty,
                 )
                 m.observe("generation_seconds", time.time() - t0)
-                timings = getattr(app.state.engine, "last_timings", None)
-                if timings:
-                    m.observe("engine_ttft_seconds", timings["ttft_s"])
-                    if timings["tokens_per_sec"]:
-                        m.observe("engine_decode_tokens_per_sec",
-                                  timings["tokens_per_sec"])
-                if not isinstance(answer, dict):
-                    logger.error("Unexpected response type: %s. Response: %s",
-                                 type(answer), answer)
-                    raise HTTPException(status_code=500,
-                                        detail="Unexpected response from model")
-                usage = answer.get("usage") or {}
-                if usage.get("completion_tokens"):
-                    m.inc("generated_tokens_total", usage["completion_tokens"])
-                response = ""
-                for choice in answer.get("choices", []):
-                    if "message" in choice:
-                        response += choice["message"]["content"]
-                return response
+                _observe_engine_timings(m)
+                return _answer_to_text(answer, m)
             except HTTPException:
                 raise
             except Exception as e:  # noqa: BLE001 — 500 semantics, api.py:76-78
                 logger.error("Error during message generation: %s", e)
+                raise HTTPException(
+                    status_code=500,
+                    detail=f"Error during message generation: {str(e)}",
+                ) from e
+
+    async def _truncate_and_generate_batch(batch_messages, semaphore):
+        """Batched analogue of ``_truncate_and_generate`` over MeshEngine.
+        Returns one entry per request: the response text, or an exception for
+        that request alone (per-entry engine errors don't fail neighbors)."""
+        m = app.state.metrics
+        async with semaphore:
+            try:
+                batch_messages = [
+                    truncate_messages_to_fit_context(ms, settings.max_context_tokens)
+                    for ms in batch_messages
+                ]
+                t0 = time.time()
+                answers = await asyncio.to_thread(
+                    app.state.engine.create_chat_completions,
+                    batch_messages,
+                    temperature=settings.temperature,
+                    top_p=settings.top_p,
+                    frequency_penalty=settings.frequency_penalty,
+                    presence_penalty=settings.presence_penalty,
+                )
+                m.observe("generation_seconds", time.time() - t0)
+                m.inc("batched_generations_total")
+                m.observe("batch_occupancy", len(batch_messages))
+                _observe_engine_timings(m)
+                out = []
+                for answer in answers:
+                    if isinstance(answer, dict) and "error" in answer:
+                        out.append(HTTPException(
+                            status_code=500,
+                            detail="Error during message generation: "
+                                   f"{answer['error'].get('message', 'unknown')}"))
+                        continue
+                    try:
+                        out.append(_answer_to_text(answer, m))
+                    except HTTPException as e:
+                        out.append(e)
+                return out
+            except Exception as e:  # noqa: BLE001 — 500 semantics, api.py:76-78
+                logger.error("Error during batched generation: %s", e)
                 raise HTTPException(
                     status_code=500,
                     detail=f"Error during message generation: {str(e)}",
@@ -257,10 +334,9 @@ def create_app(engine=None, settings: Settings | None = None,
 
 def _default_engine_factory(settings: Settings):
     def factory():
-        from ..engine import Engine
+        from ..engine import Engine, MeshEngine
 
-        eng = Engine(
-            settings.model_path,
+        kw = dict(
             n_ctx=settings.max_context_tokens,
             weight_format=settings.weight_format,
             decode_chunk=settings.decode_chunk,
@@ -268,6 +344,11 @@ def _default_engine_factory(settings: Settings):
             max_gen_tokens=settings.max_gen_tokens,
             attn_impl=settings.attn_impl,
         )
+        if settings.batch_size > 1:
+            eng = MeshEngine(settings.model_path, tp=settings.mesh_tp,
+                             batch_size=settings.batch_size, **kw)
+        else:
+            eng = Engine(settings.model_path, **kw)
         eng.warmup()
         return eng
     return factory

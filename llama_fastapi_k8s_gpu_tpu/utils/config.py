@@ -49,6 +49,11 @@ class Settings:
     prefill_buckets: str = "128,256,512,1024"  # padded prompt shapes to bound recompiles
     weight_format: str = "auto"     # auto | bf16 | int8 | q4k
     attn_impl: str = "auto"         # auto | xla | pallas (prefill flash kernel)
+    # >1 switches the server to the MeshEngine batched path: the consumer
+    # coalesces up to batch_size queued requests per generation (FIFO
+    # preserved) — the v5e-4 "concurrent /response load" config.
+    batch_size: int = 1
+    mesh_tp: int = 1                # tensor-parallel width for MeshEngine
 
     @property
     def model_path(self) -> str:
@@ -78,4 +83,6 @@ def get_settings() -> Settings:
         prefill_buckets=_env("LFKT_PREFILL_BUCKETS", Settings.prefill_buckets),
         weight_format=_env("LFKT_WEIGHT_FORMAT", Settings.weight_format),
         attn_impl=_env("LFKT_ATTN_IMPL", Settings.attn_impl),
+        batch_size=_env("LFKT_BATCH_SIZE", Settings.batch_size, int),
+        mesh_tp=_env("LFKT_MESH_TP", Settings.mesh_tp, int),
     )
