@@ -88,12 +88,22 @@ class MeshEngine(Engine):
             state, state_shardings(self.cfg, self.mesh, batched=True))
 
     # ------------------------------------------------------------------
-    def warmup(self):  # compile the batched shapes instead of the serial ones
+    def warmup(self):
+        """Compile every shape a request can hit: the batched prefill for
+        every bucket + the batched decode chunk, AND the serial path (the
+        server's /response/stream uses Engine's streaming generation)."""
         t0 = time.time()
         msgs = [{"role": "user", "content": "hi"}]
         self.create_chat_completions([msgs] * self.batch_size,
                                      max_tokens=self.decode_chunk + 1,
                                      temperature=0.0)
+        for bucket in self.prefill_buckets[1:]:
+            tokens = jnp.zeros((self.batch_size, bucket), jnp.int32)
+            lengths = jnp.ones((self.batch_size,), jnp.int32)
+            _, caches = batched_prefill_jit(
+                self.params, self.cfg, tokens, lengths, self._bstate["cache"])
+            self._bstate["cache"] = caches
+        super().warmup()  # serial buckets + decode chunk (streaming path)
         logger.info("mesh warmup done in %.1fs (dp=%d tp=%d batch=%d)",
                     time.time() - t0, self.mesh.shape["dp"],
                     self.mesh.shape["tp"], self.batch_size)
@@ -179,7 +189,18 @@ class MeshEngine(Engine):
         ttft = time.time() - t0
 
         stop_ids = self.tokenizer.stop_ids
-        budgets = [self._token_budget(max_tokens, len(i)) for i in ids_list]
+        # Per-lane budget AND per-lane cache capacity: lane b may store
+        # n_ctx-1-len_b new tokens regardless of its neighbors' prompt
+        # lengths (a global clamp would let the longest prompt truncate
+        # everyone).  Lanes that exhaust their own capacity keep decoding
+        # on-device (vmap advances every lane) — their writes clamp to the
+        # last slot of their own cache and their tokens are discarded here;
+        # the next batch re-prefills, so the garbage is never read.
+        budgets = [
+            min(self._token_budget(max_tokens, len(i)),
+                max(0, self.cfg.n_ctx - 1 - len(i)))
+            for i in ids_list
+        ]
         gens: list[list[int]] = []
         done = [False] * B
         finishes = ["length"] * B                     # same default as Engine._run
@@ -193,18 +214,15 @@ class MeshEngine(Engine):
                 finishes[b] = "stop"
             else:
                 gens.append([tok])
-        max_pos = int(np.max(np.asarray(lengths))) + 1
 
         while not all(done):
             remaining = max(budgets[b] - len(gens[b]) for b in range(B) if not done[b])
-            n_steps = min(self.decode_chunk, remaining,
-                          self.cfg.n_ctx - max_pos - 1)
+            n_steps = min(self.decode_chunk, remaining)
             if n_steps <= 0:
-                break                                 # context window: "length"
+                break                                 # capacity: "length"
             state, toks = batched_generate_chunk_jit(
                 self.params, self.cfg, state, st,
                 n_steps=n_steps, top_k=sp.top_k)
-            max_pos += n_steps
             chunk = np.asarray(toks)                  # (n_steps, B) host sync
             for b in range(B):
                 if done[b]:

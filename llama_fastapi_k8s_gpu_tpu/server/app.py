@@ -26,7 +26,15 @@ import logging
 import time
 from datetime import datetime
 
-from .asgikit import HTTPException, MicroAPI, PlainTextResponse, Request
+import json
+
+from .asgikit import (
+    HTTPException,
+    MicroAPI,
+    PlainTextResponse,
+    Request,
+    StreamingResponse,
+)
 
 from ..utils.config import Settings, get_settings
 from ..utils.metrics import Metrics
@@ -34,6 +42,8 @@ from .schemas import BotMessageRequest
 
 logging.basicConfig(level=logging.INFO)
 logger = logging.getLogger(__name__)
+
+_STREAM_DONE = object()  # consumer→handler sentinel: stream finished cleanly
 
 
 def count_tokens_roughly(text: str) -> int:
@@ -108,12 +118,14 @@ def create_app(engine=None, settings: Settings | None = None,
                 except asyncio.QueueEmpty:
                     break
             now = time.time()
-            live = []
+            live, streams = [], []
             for rd in batch:
                 app.state.metrics.observe(
                     "queue_wait_seconds", now - rd["enqueued_at"])
                 if rd["future"].cancelled():
                     logger.info("Future was cancelled before processing; skipping.")
+                elif "stream_queue" in rd:
+                    streams.append(rd)
                 else:
                     live.append(rd)
             results: list[tuple] = []           # (request, response, error)
@@ -144,6 +156,15 @@ def create_app(engine=None, settings: Settings | None = None,
                     rd["future"].set_exception(err)
                 else:
                     rd["future"].set_result(resp)
+            for rd in streams:      # streaming requests, serial, in FIFO slot
+                try:
+                    await _truncate_and_stream(rd, semaphore)
+                except Exception as e:  # noqa: BLE001 — never kill the consumer
+                    logger.error("Error during streamed generation: %s", e)
+                    try:
+                        rd["stream_queue"].put_nowait(e)
+                    except Exception:  # noqa: BLE001
+                        pass
             for _ in batch:
                 queue.task_done()
 
@@ -241,6 +262,38 @@ def create_app(engine=None, settings: Settings | None = None,
                     detail=f"Error during message generation: {str(e)}",
                 ) from e
 
+    async def _truncate_and_stream(rd, semaphore):
+        """Run one streaming generation, forwarding engine chunks to the
+        handler's queue from the worker thread.  Mirrors the reference's
+        no-mid-generation-abort behavior: a disconnected client just stops
+        consuming; generation runs to completion and chunks are dropped."""
+        m = app.state.metrics
+        chunk_q = rd["stream_queue"]
+        loop = asyncio.get_running_loop()
+        async with semaphore:
+            messages = truncate_messages_to_fit_context(
+                rd["messages"], settings.max_context_tokens)
+
+            def run():
+                try:
+                    for chunk in app.state.engine.create_chat_completion(
+                            messages=messages,
+                            stream=True,
+                            temperature=settings.temperature,
+                            top_p=settings.top_p,
+                            frequency_penalty=settings.frequency_penalty,
+                            presence_penalty=settings.presence_penalty):
+                        loop.call_soon_threadsafe(chunk_q.put_nowait, chunk)
+                    loop.call_soon_threadsafe(chunk_q.put_nowait, _STREAM_DONE)
+                except Exception as e:  # noqa: BLE001 — surfaced as SSE error
+                    loop.call_soon_threadsafe(chunk_q.put_nowait, e)
+
+            t0 = time.time()
+            await asyncio.to_thread(run)
+            m.observe("generation_seconds", time.time() - t0)
+            m.inc("streamed_generations_total")
+            _observe_engine_timings(m)
+
     @app.on_event("startup")
     async def startup_event():
         app.state.queue = asyncio.Queue(maxsize=settings.max_queue_size)
@@ -252,8 +305,11 @@ def create_app(engine=None, settings: Settings | None = None,
         app.state.ready = True
         app.state.consumer_task = asyncio.create_task(consumer())
 
-    @app.post("/response")
-    async def generate_response(request_body: BotMessageRequest, request: Request):
+    def _admit(request_body: BotMessageRequest, request: Request,
+               extra: dict | None = None) -> dict:
+        """Shared admission for both response endpoints: assemble messages
+        (system prompt inserted at index 1 — quirk preserved from reference
+        api.py:147), enqueue with a future, 503 on overflow."""
         queue = request.app.state.queue
         m = request.app.state.metrics
         messages = [
@@ -261,19 +317,28 @@ def create_app(engine=None, settings: Settings | None = None,
             for message in request_body.context
         ]
         system_prompt = build_system_prompt(request_body.bot_profile)
-        # index 1, not 0 — quirk preserved from reference api.py:147
         messages.insert(1, {"role": "system", "content": system_prompt})
 
-        loop = asyncio.get_running_loop()
-        future = loop.create_future()
+        rd = {
+            "messages": messages,
+            "future": asyncio.get_running_loop().create_future(),
+            "enqueued_at": time.time(),
+            **(extra or {}),
+        }
         try:
-            queue.put_nowait({"messages": messages, "future": future,
-                              "enqueued_at": time.time()})
+            queue.put_nowait(rd)
         except asyncio.QueueFull:
             m.inc("requests_rejected_total")
             raise HTTPException(status_code=503,
                                 detail="Server too busy. Please try again later.")
         m.set_gauge("queue_depth", queue.qsize())
+        return rd
+
+    @app.post("/response")
+    async def generate_response(request_body: BotMessageRequest, request: Request):
+        m = request.app.state.metrics
+        rd = _admit(request_body, request)
+        future = rd["future"]
         try:
             response = await asyncio.wait_for(future, timeout=settings.timeout_seconds)
             return {"response": response}
@@ -288,6 +353,41 @@ def create_app(engine=None, settings: Settings | None = None,
             logger.error("Internal server error: %s", e)
             raise HTTPException(status_code=500,
                                 detail=f"Internal server error: {str(e)}")
+
+    @app.post("/response/stream")
+    async def generate_response_stream(request_body: BotMessageRequest,
+                                       request: Request):
+        """Streaming variant of ``/response`` (BASELINE config "streaming
+        completion"): same admission control (queue slot, 503 on overflow,
+        timeout per chunk-gap), same prompt assembly; emits server-sent
+        events with OpenAI chunk dicts, terminated by ``data: [DONE]``."""
+        m = request.app.state.metrics
+        rd = _admit(request_body, request,
+                    extra={"stream_queue": asyncio.Queue()})
+
+        async def sse():
+            while True:
+                try:
+                    chunk = await asyncio.wait_for(
+                        rd["stream_queue"].get(),
+                        timeout=settings.timeout_seconds)
+                except asyncio.TimeoutError:
+                    m.inc("requests_timed_out_total")
+                    rd["future"].cancel()
+                    yield ("data: "
+                           + json.dumps({"error": "Generation timed out"})
+                           + "\n\n")
+                    return
+                if chunk is _STREAM_DONE:
+                    yield "data: [DONE]\n\n"
+                    return
+                if isinstance(chunk, Exception):
+                    yield ("data: "
+                           + json.dumps({"error": str(chunk)}) + "\n\n")
+                    return
+                yield "data: " + json.dumps(chunk) + "\n\n"
+
+        return StreamingResponse(sse())
 
     @app.get("/health")
     async def health():

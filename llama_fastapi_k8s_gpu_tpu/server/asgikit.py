@@ -104,6 +104,35 @@ class JSONResponse(Response):
         return json.dumps(content).encode()
 
 
+class StreamingResponse(Response):
+    """Incremental body from a sync or async iterator of str/bytes chunks.
+
+    Sent as multiple ``http.response.body`` messages with ``more_body``;
+    uvicorn and the in-tree httpd (chunked transfer-encoding) both consume
+    that shape.  Default media type suits server-sent events.
+    """
+
+    media_type = "text/event-stream"
+
+    def __init__(self, iterator, status_code: int = 200,
+                 headers: dict[str, str] | None = None,
+                 media_type: str | None = None):
+        self.status_code = status_code
+        self.headers = dict(headers or {})
+        self.media_type = media_type or type(self).media_type
+        self.iterator = iterator
+        self.body = b""
+
+    async def chunks(self):
+        it = self.iterator
+        if hasattr(it, "__aiter__"):
+            async for chunk in it:
+                yield chunk if isinstance(chunk, bytes) else str(chunk).encode()
+        else:
+            for chunk in it:
+                yield chunk if isinstance(chunk, bytes) else str(chunk).encode()
+
+
 class _Route:
     _PARAM_RE = re.compile(r"{(\w+)}")
 
@@ -296,6 +325,18 @@ class MicroAPI:
 
         request = Request(self, scope, body)
         response = await self._handle(request)
+        if isinstance(response, StreamingResponse):
+            headers = [(b"content-type", response.media_type.encode()),
+                       (b"cache-control", b"no-cache")]
+            headers += [(k.encode(), v.encode())
+                        for k, v in response.headers.items()]
+            await send({"type": "http.response.start",
+                        "status": response.status_code, "headers": headers})
+            async for chunk in response.chunks():
+                await send({"type": "http.response.body", "body": chunk,
+                            "more_body": True})
+            await send({"type": "http.response.body", "body": b""})
+            return
         headers = [(b"content-type", response.media_type.encode()),
                    (b"content-length", str(len(response.body)).encode())]
         headers += [(k.encode(), v.encode()) for k, v in response.headers.items()]

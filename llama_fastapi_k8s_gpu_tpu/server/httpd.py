@@ -68,30 +68,57 @@ async def _handle_connection(app, reader: asyncio.StreamReader,
                     return messages.pop(0)
                 return {"type": "http.disconnect"}
 
-            response = {"status": 500, "headers": [], "body": b""}
+            # Buffered by default; switches to chunked transfer-encoding the
+            # moment the app sends a body part with more_body=True (streaming
+            # responses — SSE /response/stream).
+            response = {"status": 500, "headers": [], "body": b"",
+                        "streaming": False}
+
+            def _write_head(chunked: bool):
+                status = response["status"]
+                head = [f"HTTP/1.1 {status} {_REASONS.get(status, '')}".encode()]
+                has_length = False
+                for k, v in response["headers"]:
+                    if k.lower() == b"content-length":
+                        has_length = True
+                    head.append(k + b": " + v)
+                if chunked:
+                    head.append(b"transfer-encoding: chunked")
+                elif not has_length:
+                    head.append(
+                        b"content-length: " + str(len(response["body"])).encode())
+                head.append(b"connection: keep-alive")
+                writer.write(b"\r\n".join(head) + b"\r\n\r\n")
 
             async def send(message):
                 if message["type"] == "http.response.start":
                     response["status"] = message["status"]
                     response["headers"] = message.get("headers", [])
                 elif message["type"] == "http.response.body":
-                    response["body"] += message.get("body", b"")
+                    body = message.get("body", b"")
+                    if message.get("more_body"):
+                        if not response["streaming"]:
+                            response["streaming"] = True
+                            _write_head(chunked=True)
+                        if body:
+                            writer.write(
+                                f"{len(body):x}\r\n".encode() + body + b"\r\n")
+                            await writer.drain()
+                    elif response["streaming"]:
+                        if body:
+                            writer.write(
+                                f"{len(body):x}\r\n".encode() + body + b"\r\n")
+                        writer.write(b"0\r\n\r\n")
+                        await writer.drain()
+                    else:
+                        response["body"] += body
 
             await app(scope, receive, send)
 
-            status = response["status"]
-            reason = _REASONS.get(status, "")
-            head = [f"HTTP/1.1 {status} {reason}".encode()]
-            has_length = False
-            for k, v in response["headers"]:
-                if k.lower() == b"content-length":
-                    has_length = True
-                head.append(k + b": " + v)
-            if not has_length:
-                head.append(b"content-length: " + str(len(response["body"])).encode())
-            head.append(b"connection: keep-alive")
-            writer.write(b"\r\n".join(head) + b"\r\n\r\n" + response["body"])
-            await writer.drain()
+            if not response["streaming"]:
+                _write_head(chunked=False)
+                writer.write(response["body"])
+                await writer.drain()
     except (asyncio.IncompleteReadError, ConnectionResetError):
         pass
     finally:
@@ -116,7 +143,9 @@ async def serve(app, host: str = "0.0.0.0", port: int = 8000,
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
             loop.add_signal_handler(sig, stop.set)
-        except NotImplementedError:
+        except (NotImplementedError, RuntimeError, ValueError):
+            # non-main thread (tests/embedding) or unsupported platform:
+            # graceful-shutdown-by-signal just isn't available there
             pass
     async with server:
         await stop.wait()

@@ -99,3 +99,82 @@ def write_tiny_llama_gguf(
     t("output.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
     w.write()
     return cfg
+
+
+def spm_byte_vocab() -> tuple[list[str], list[int], list[float]]:
+    """Minimal SentencePiece-style vocab: specials + full byte fallback."""
+    tokens = ["<unk>", "<s>", "</s>", "▁"]
+    types = [int(TokenType.UNKNOWN)] + [int(TokenType.CONTROL)] * 2 + [
+        int(TokenType.NORMAL)]
+    scores = [0.0, 0.0, 0.0, -1.0]
+    for b in range(256):
+        tokens.append(f"<0x{b:02X}>")
+        types.append(int(TokenType.BYTE))
+        scores.append(0.0)
+    return tokens, types, scores
+
+
+def write_tiny_mistral_gguf(
+    path: str,
+    cfg: ModelConfig | None = None,
+    seed: int = 0,
+    quant: GGMLType = GGMLType.Q8_0,
+) -> ModelConfig:
+    """Random-weight **mistral**-architecture GGUF: SPM tokenizer with byte
+    fallback, sliding-window attention, [INST] chat template — the
+    reference-baseline "Mistral-7B sliding-window" config (BASELINE.json)
+    at test scale."""
+    tokens, types, scores = spm_byte_vocab()
+    base = cfg or TINY_CFG
+    cfg = ModelConfig(**{**base.__dict__, "vocab_size": len(tokens),
+                         "sliding_window": base.sliding_window or 16})
+    rng = np.random.default_rng(seed)
+    scale = cfg.dim ** -0.5
+
+    w = GGUFWriter(path)
+    w.add_metadata("general.architecture", "mistral")
+    w.add_metadata("general.name", "tiny-mistral-test")
+    w.add_metadata("mistral.block_count", cfg.n_layers)
+    w.add_metadata("mistral.context_length", cfg.n_ctx)
+    w.add_metadata("mistral.embedding_length", cfg.dim)
+    w.add_metadata("mistral.feed_forward_length", cfg.ffn_dim)
+    w.add_metadata("mistral.attention.head_count", cfg.n_heads)
+    w.add_metadata("mistral.attention.head_count_kv", cfg.n_kv_heads)
+    w.add_metadata("mistral.attention.layer_norm_rms_epsilon", cfg.rms_eps)
+    w.add_metadata("mistral.attention.sliding_window", cfg.sliding_window)
+    w.add_metadata("mistral.rope.freq_base", cfg.rope_theta)
+    w.add_metadata("mistral.vocab_size", cfg.vocab_size)
+    w.add_metadata("tokenizer.ggml.model", "llama")
+    w.add_metadata("tokenizer.ggml.tokens", tokens)
+    w.add_metadata("tokenizer.ggml.token_type", types)
+    w.add_metadata("tokenizer.ggml.scores", scores)
+    w.add_metadata("tokenizer.ggml.bos_token_id", 1)
+    w.add_metadata("tokenizer.ggml.eos_token_id", 2)
+    w.add_metadata(
+        "tokenizer.chat_template",
+        "{{bos_token}}{% for m in messages %}{% if m['role'] == 'user' %}"
+        "[INST] {{m['content']}} [/INST]{% else %}{{m['content']}}</s>"
+        "{% endif %}{% endfor %}",
+    )
+
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+
+    def t(name, shape, gtype):
+        w.add_tensor(name, rng.standard_normal(shape).astype(np.float32) * scale, gtype)
+
+    t("token_embd.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
+    for i in range(cfg.n_layers):
+        p = f"blk.{i}."
+        t(p + "attn_norm.weight", (cfg.dim,), GGMLType.F32)
+        t(p + "attn_q.weight", (cfg.dim, cfg.dim), quant)
+        t(p + "attn_k.weight", (kv_dim, cfg.dim), quant)
+        t(p + "attn_v.weight", (kv_dim, cfg.dim), quant)
+        t(p + "attn_output.weight", (cfg.dim, cfg.dim), quant)
+        t(p + "ffn_norm.weight", (cfg.dim,), GGMLType.F32)
+        t(p + "ffn_gate.weight", (cfg.ffn_dim, cfg.dim), quant)
+        t(p + "ffn_up.weight", (cfg.ffn_dim, cfg.dim), quant)
+        t(p + "ffn_down.weight", (cfg.dim, cfg.ffn_dim), quant)
+    t("output_norm.weight", (cfg.dim,), GGMLType.F32)
+    t("output.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
+    w.write()
+    return cfg

@@ -120,3 +120,19 @@ def test_oversized_prompt_isolated(mesh_engine):
     assert "exceed context window" in outs[0]["error"]["message"]
     assert outs[1]["object"] == "chat.completion"
     assert outs[1]["usage"]["completion_tokens"] >= 1
+
+
+def test_long_prompt_neighbor_does_not_truncate_short(mesh_engine):
+    """Per-lane capacity: a long-prompt neighbor must not clamp a short
+    request's budget to the batch-global context remainder."""
+    short = [{"role": "user", "content": "hi"}]
+    # ~100-token prompt in a 128-ctx model: leaves only ~27 slots for ITSELF
+    long_p = [{"role": "user", "content": "y" * 80}]
+    solo = mesh_engine.create_chat_completions([short], temperature=0.0,
+                                               max_tokens=12)[0]
+    crowd = mesh_engine.create_chat_completions([short, long_p],
+                                                temperature=0.0,
+                                                max_tokens=12)[0]
+    assert crowd["usage"]["completion_tokens"] == solo["usage"]["completion_tokens"]
+    assert crowd["choices"][0]["message"]["content"] == \
+        solo["choices"][0]["message"]["content"]

@@ -100,3 +100,20 @@ def test_usage_counts_against_tokenizer(engine):
     out = engine.create_chat_completion(MSGS, temperature=0.0, max_tokens=8)
     ids = engine.tokenize_messages(MSGS)
     assert out["usage"]["prompt_tokens"] == len(ids)
+
+
+def test_mistral_gguf_end_to_end(tmp_path):
+    """BASELINE config "Mistral-7B sliding-window": mistral-arch GGUF with an
+    SPM byte-fallback tokenizer loads, detects the [INST] template, applies
+    the sliding window, and generates."""
+    from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_mistral_gguf
+
+    path = str(tmp_path / "tiny-mistral.gguf")
+    write_tiny_mistral_gguf(path)
+    eng = Engine(path, n_ctx=64, decode_chunk=4, max_gen_tokens=8,
+                 prefill_buckets=(32, 64))
+    assert eng.cfg.sliding_window > 0
+    assert eng.template_kind == "mistral"
+    out = eng.create_chat_completion(MSGS, max_tokens=4, seed=0)
+    assert out["object"] == "chat.completion"
+    assert out["usage"]["completion_tokens"] >= 1

@@ -189,3 +189,54 @@ def test_gender_clause_and_appearance():
     p2 = BotProfile(name="Max", appearance="a,b,c")
     sp2 = build_system_prompt(p2)
     assert "You a boy." in sp2 and sp2.endswith("You a boy.")
+
+
+# ---------------------------------------------------------------------------
+# streaming (/response/stream — BASELINE "streaming completion" config)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.anyio
+async def test_response_stream_sse():
+    engine = FakeEngine(reply="hey")
+    app, transport = make_client(engine)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            r = await client.post("/response/stream", json=BODY)
+            assert r.status_code == 200
+            assert r.headers["content-type"].startswith("text/event-stream")
+            events = [ln for ln in r.text.split("\n\n") if ln.startswith("data: ")]
+            assert events[-1] == "data: [DONE]"
+            import json as _json
+            chunks = [_json.loads(e[6:]) for e in events[:-1]]
+            text = "".join(c["choices"][0]["delta"].get("content", "")
+                           for c in chunks)
+            assert text == "hey"
+            assert chunks[0]["choices"][0]["delta"] == {"role": "assistant"}
+        await app.router.shutdown()
+
+
+@pytest.mark.anyio
+async def test_response_stream_timeout_event():
+    engine = FakeEngine(reply="x", delay=1.0)
+    app, transport = make_client(engine, timeout_seconds=0.1)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            r = await client.post("/response/stream", json=BODY)
+            assert r.status_code == 200
+            assert "Generation timed out" in r.text
+        await app.router.shutdown()
+
+
+@pytest.mark.anyio
+async def test_response_stream_engine_error_event():
+    engine = FakeEngine(fail=RuntimeError("boom"))
+    app, transport = make_client(engine)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            r = await client.post("/response/stream", json=BODY)
+            assert r.status_code == 200
+            assert "boom" in r.text
+        await app.router.shutdown()
