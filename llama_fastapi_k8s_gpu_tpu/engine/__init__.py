@@ -1,3 +1,4 @@
 from .batched import MeshEngine  # noqa: F401
+from .continuous import ContinuousEngine  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .fake import FakeEngine  # noqa: F401

@@ -1,0 +1,331 @@
+"""Continuous batching: slot-based scheduling over the mesh-batched engine.
+
+:class:`MeshEngine` coalesces requests into *cycles* — everyone admitted
+together, nobody new until the whole cycle drains.  This module removes the
+barrier: the batch's B lanes become **slots**; at every decode-chunk boundary
+finished lanes are freed and waiting requests are admitted into them
+(single-sequence prefill into a scratch cache, then a jit'd lane write into
+the batched state).  Decode keeps running for whatever lanes are live, so
+short requests exit early and long ones never block admission — the
+vLLM-style serving loop, TPU-native: static shapes throughout, one compiled
+program per (bucket | chunk | lane-write) shape, batch dim sharded over
+``dp`` and the model over ``tp``.
+
+The reference's concurrency model (one generation at a time behind
+Queue(5)+Semaphore(1), reference api.py:110-116) is the degenerate B=1 case;
+back-pressure (503) and per-request timeouts stay at the server layer.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import queue as queue_mod
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import prefill_jit, sample_jit
+from ..models.llama import init_cache
+from ..parallel.batched import batched_generate_chunk_perlane_jit
+from ..sampling.sample import SamplingParams, sampling_tensors, seed_window
+from .batched import MeshEngine
+from .engine import Engine
+
+logger = logging.getLogger(__name__)
+
+
+@functools.partial(jax.jit, donate_argnames=("state", "lane_st"))
+def _write_lane(state: dict, lane_st: dict, lane: jax.Array, cache1: dict,
+                pos, token, window, wpos, key, st: dict):
+    """Install a freshly prefilled sequence into batch lane ``lane``.
+    ``cache1`` is NOT donated — the scheduler reuses it as the next
+    admission's prefill scratch (no per-request cache allocation)."""
+    new_cache = {
+        "k": state["cache"]["k"].at[lane].set(cache1["k"]),
+        "v": state["cache"]["v"].at[lane].set(cache1["v"]),
+    }
+    new_state = {
+        "cache": new_cache,
+        "pos": state["pos"].at[lane].set(pos),
+        "token": state["token"].at[lane].set(token),
+        "window": state["window"].at[lane].set(window),
+        "wpos": state["wpos"].at[lane].set(wpos),
+        "key": state["key"].at[lane].set(key),
+    }
+    new_lane_st = jax.tree.map(
+        lambda a, v: a.at[lane].set(v), lane_st, st)
+    return new_state, new_lane_st
+
+
+class _Slot:
+    __slots__ = ("future", "gens", "budget", "n_prompt", "ids",
+                 "first_token", "stops", "st", "sp", "t_admit", "ttft_s")
+
+    def __init__(self, future, budget, n_prompt, ids):
+        self.future = future
+        self.gens: list[int] = []
+        self.budget = budget
+        self.n_prompt = n_prompt
+        self.ids = ids
+
+
+class ContinuousEngine(MeshEngine):
+    """MeshEngine + a background scheduler thread with per-lane admission.
+
+    Use :meth:`submit` (returns a ``concurrent.futures.Future`` resolving to
+    the OpenAI-shaped dict) or the blocking ``create_chat_completion`` /
+    ``create_chat_completions`` facades, which route through the scheduler.
+    """
+
+    def __init__(self, model_path: str | None, **kw):
+        super().__init__(model_path, **kw)
+        self._scratch_cache = init_cache(self.cfg)
+        base_st = sampling_tensors(SamplingParams())
+        self._lane_st = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (self.batch_size,)), base_st)
+        self._default_top_k = SamplingParams().top_k
+        self._pending: queue_mod.Queue = queue_mod.Queue()
+        self._wake = threading.Event()
+        self._stop = False
+        self._loop_error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._loop, name="lfkt-scheduler", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, messages: Sequence[dict], *, temperature: float = 0.2,
+               top_p: float = 0.95, top_k: int = 40, min_p: float = 0.05,
+               frequency_penalty: float = 0.0, presence_penalty: float = 0.0,
+               repeat_penalty: float = 1.1, max_tokens: int | None = None,
+               stop: Sequence[str] | str | None = None,
+               seed: int | None = None) -> Future:
+        """Queue one request; the scheduler admits it to a free lane."""
+        if self._loop_error is not None:
+            raise RuntimeError("scheduler died") from self._loop_error
+        if self._stop:
+            raise RuntimeError("engine has been shut down")
+        if top_k != self._default_top_k:
+            # top_k is a static jit arg of the shared decode program; lanes
+            # can't mix values (every other knob is per-lane)
+            raise ValueError(
+                f"continuous scheduler serves a fixed top_k="
+                f"{self._default_top_k}; per-request top_k is not supported")
+        sp = SamplingParams(
+            temperature=temperature, top_p=top_p, top_k=top_k, min_p=min_p,
+            frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty, repeat_penalty=repeat_penalty,
+        )
+        if isinstance(stop, str):
+            stop = [stop]
+        fut: Future = Future()
+        self._pending.put((fut, list(messages), sp, max_tokens,
+                           list(stop or []), seed))
+        self._wake.set()
+        return fut
+
+    def create_chat_completion(self, messages, stream: bool = False, **kw):
+        if stream:  # serial streaming path unchanged (warmed by warmup)
+            return super().create_chat_completion(messages, stream=True, **kw)
+        return self.submit(messages, **kw).result()
+
+    def create_chat_completions(self, batch_messages, **kw) -> list[dict]:
+        futs = [self.submit(m, **kw) for m in batch_messages]
+        out = []
+        for f in futs:
+            try:
+                out.append(f.result())
+            except ValueError as e:  # per-request input error, isolated
+                out.append({"error": {"message": str(e),
+                                      "type": "invalid_request_error"}})
+        return out
+
+    def shutdown(self):
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    def warmup(self):
+        """Compile the scheduler's shapes: serial prefill (every bucket),
+        first-token sampling, the lane write, the batched decode chunk, and
+        the serial streaming path."""
+        t0 = time.time()
+        msgs = [{"role": "user", "content": "hi"}]
+        futs = [self.submit(msgs, max_tokens=self.decode_chunk + 1,
+                            temperature=0.0)
+                for _ in range(self.batch_size)]
+        for f in futs:
+            f.result()
+        # serial streaming path (its decode-chunk program is separate)
+        list(Engine.create_chat_completion(
+            self, msgs, stream=True, max_tokens=self.decode_chunk + 1,
+            temperature=0.0))
+        Engine.warmup(self)  # remaining prefill buckets
+        logger.info("continuous warmup done in %.1fs (%d lanes)",
+                    time.time() - t0, self.batch_size)
+
+    # ------------------------------------------------------------------
+    # scheduler internals (all device work on the scheduler thread)
+    # ------------------------------------------------------------------
+
+    def _admit_one(self, lane: int, item) -> _Slot | None:
+        fut, messages, sp, max_tokens, stops, seed = item
+        if not fut.set_running_or_notify_cancel():
+            return None                                # cancelled while queued
+        t0 = time.time()
+        try:
+            ids = self.tokenize_messages(messages)
+            if len(ids) >= self.cfg.n_ctx:
+                raise ValueError(
+                    f"Requested tokens ({len(ids)}) exceed context window "
+                    f"of {self.cfg.n_ctx}")
+            n_prompt = len(ids)
+            bucket = self._bucket_for(n_prompt)
+            padded = ids + [0] * (bucket - n_prompt)
+            st = sampling_tensors(sp)
+            if seed is None:
+                seed = self._base_seed + self._requests
+            self._requests += 1
+
+            logits, cache1 = prefill_jit(
+                self.params, self.cfg, jnp.asarray(padded, jnp.int32),
+                jnp.int32(n_prompt), self._scratch_cache)
+            window, wpos = seed_window(ids)
+            token, window, wpos, key = sample_jit(
+                logits, window, wpos, jax.random.PRNGKey(seed), st, self.cfg,
+                top_k=sp.top_k)
+            self._bstate, self._lane_st = _write_lane(
+                self._bstate, self._lane_st, jnp.int32(lane), cache1,
+                jnp.int32(n_prompt), token, window, wpos, key, st)
+            self._scratch_cache = cache1  # not donated: next prefill reuses it
+
+            budget = min(self._token_budget(max_tokens, n_prompt),
+                         max(0, self.cfg.n_ctx - 1 - n_prompt))
+            slot = _Slot(fut, budget, n_prompt, ids)
+            slot.first_token = int(token)   # host sync: prefill done = TTFT
+            slot.stops = stops
+            slot.st = st
+            slot.sp = sp
+            slot.t_admit = t0
+            slot.ttft_s = time.time() - t0
+            return slot
+        except Exception as e:  # noqa: BLE001 — per-request isolation
+            fut.set_exception(e)
+            return None
+
+    def _finish_slot(self, slot: _Slot, finish: str):
+        text = self._decode_text(slot.gens)
+        cut = self._find_stop_str(text, slot.stops)
+        if cut != -1:
+            text = text[:cut]
+            finish = "stop"
+        decode_s = time.time() - slot.t_admit - slot.ttft_s
+        n = len(slot.gens)
+        self.last_timings = {
+            "ttft_s": slot.ttft_s, "decode_s": decode_s,
+            "prompt_tokens": slot.n_prompt, "completion_tokens": n,
+            "tokens_per_sec": (n - 1) / decode_s
+            if n > 1 and decode_s > 0 else 0.0,
+        }
+        slot.future.set_result({
+            "id": f"chatcmpl-{uuid.uuid4().hex}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": finish,
+            }],
+            "usage": {
+                "prompt_tokens": slot.n_prompt,
+                "completion_tokens": len(slot.gens),
+                "total_tokens": slot.n_prompt + len(slot.gens),
+            },
+        })
+
+    def _loop(self):
+        B = self.batch_size
+        slots: list[_Slot | None] = [None] * B
+        stop_ids = self.tokenizer.stop_ids
+        try:
+            while not self._stop:
+                # ---- admit into free lanes ---------------------------------
+                for lane in range(B):
+                    if slots[lane] is not None:
+                        continue
+                    try:
+                        item = self._pending.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    slot = self._admit_one(lane, item)
+                    if slot is None:
+                        continue
+                    first = slot.first_token
+                    if slot.budget <= 0:
+                        self._finish_slot(slot, "length")
+                    elif first in stop_ids:
+                        self._finish_slot(slot, "stop")
+                    else:
+                        slot.gens.append(first)
+                        if len(slot.gens) >= slot.budget:
+                            self._finish_slot(slot, "length")
+                        else:
+                            slots[lane] = slot
+
+                live = [s for s in slots if s is not None]
+                if not live:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+
+                # ---- one decode chunk for every lane (per-lane sampling
+                # knobs ride in self._lane_st; top_k is globally static) ----
+                self._bstate, toks = batched_generate_chunk_perlane_jit(
+                    self.params, self.cfg, self._bstate, self._lane_st,
+                    n_steps=self.decode_chunk, top_k=self._default_top_k)
+                chunk = np.asarray(toks)                   # (n_steps, B)
+
+                # ---- harvest ----------------------------------------------
+                # (There is no mid-generation abort for abandoned clients —
+                # reference parity, api.py:97-100: the generation runs to
+                # completion and the result is simply discarded downstream.)
+                for lane in range(B):
+                    slot = slots[lane]
+                    if slot is None:
+                        continue
+                    finish = None
+                    for t in chunk[:, lane].tolist():
+                        if t in stop_ids:
+                            finish = "stop"
+                            break
+                        slot.gens.append(t)
+                        if len(slot.gens) >= slot.budget:
+                            finish = "length"
+                            break
+                    if finish is not None:
+                        self._finish_slot(slot, finish)
+                        slots[lane] = None
+        except BaseException as e:  # noqa: BLE001 — fail all, loudly
+            self._loop_error = e
+            logger.exception("scheduler loop died")
+        finally:
+            # graceful stop AND crash both resolve every outstanding future:
+            # a caller blocked in Future.result() must never hang
+            err = self._loop_error or RuntimeError("engine has been shut down")
+            for s in slots:
+                if s is not None and not s.future.done():
+                    s.future.set_exception(err)
+            while True:
+                try:
+                    fut = self._pending.get_nowait()[0]
+                except queue_mod.Empty:
+                    break
+                if not fut.done() and not fut.cancel():
+                    fut.set_exception(err)

@@ -47,8 +47,8 @@ def batched_prefill_jit(params, cfg: ModelConfig, tokens, lengths, caches):
 )
 def batched_generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
                                n_steps: int, top_k: int = 40):
-    """B sequences × n_steps decode+sample steps on device.
-    Returns (state, tokens (n_steps, B))."""
+    """B sequences × n_steps decode+sample steps on device, one shared set
+    of sampling knobs.  Returns (state, tokens (n_steps, B))."""
 
     def one_step(carry, _):
         def single(token, pos, cache, window, wpos, key):
@@ -61,6 +61,39 @@ def batched_generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
         tok, pos, cache, window, wpos, key = jax.vmap(single)(
             carry["token"], carry["pos"], carry["cache"],
             carry["window"], carry["wpos"], carry["key"],
+        )
+        new_carry = {"cache": cache, "pos": pos, "token": tok,
+                     "window": window, "wpos": wpos, "key": key}
+        return new_carry, tok
+
+    return jax.lax.scan(one_step, state, None, length=n_steps)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "top_k"),
+    donate_argnames=("state",),
+)
+def batched_generate_chunk_perlane_jit(params, cfg: ModelConfig, state: dict,
+                                       lane_st: dict, n_steps: int,
+                                       top_k: int = 40):
+    """Like :func:`batched_generate_chunk_jit` but with **per-lane** sampling
+    knobs (``lane_st`` leaves have a leading B dim) — the continuous
+    scheduler admits requests with different temperatures/penalties into
+    neighboring lanes.  (top_k stays a shared static: ``lax.top_k`` needs a
+    static k; see ContinuousEngine.submit.)"""
+
+    def one_step(carry, _):
+        def single(token, pos, cache, window, wpos, key, st):
+            logits, cache = forward(params, cfg, token[None], pos, cache)
+            key, sub = jax.random.split(key)
+            tok = sample_chain(logits, window, sub, st, top_k=top_k)
+            window = window.at[wpos % PENALTY_WINDOW].set(tok)
+            return tok, pos + 1, cache, window, wpos + 1, key
+
+        tok, pos, cache, window, wpos, key = jax.vmap(single)(
+            carry["token"], carry["pos"], carry["cache"],
+            carry["window"], carry["wpos"], carry["key"], lane_st,
         )
         new_carry = {"cache": cache, "pos": pos, "token": tok,
                      "window": window, "wpos": wpos, "key": key}

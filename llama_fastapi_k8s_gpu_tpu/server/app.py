@@ -110,6 +110,28 @@ def create_app(engine=None, settings: Settings | None = None,
         semaphore = app.state.semaphore
         while True:
             batch = [await queue.get()]
+            continuous = hasattr(app.state.engine, "submit")
+            if continuous:
+                # slot scheduler: forward without a barrier — the engine
+                # admits into free lanes at chunk boundaries.  In-flight
+                # count is capped at batch_size so the bounded queue is
+                # still the back-pressure surface (503 on overflow);
+                # without the cap the engine's pending queue would absorb
+                # unlimited work and 503 could never fire.
+                rd = batch[0]
+                app.state.metrics.observe(
+                    "queue_wait_seconds", time.time() - rd["enqueued_at"])
+                if rd["future"].cancelled():
+                    logger.info("Future was cancelled before processing; skipping.")
+                elif "stream_queue" in rd:
+                    # engine-internal lock serializes streams; don't block
+                    # the consumer behind the whole stream generation
+                    asyncio.ensure_future(_stream_task(rd))
+                else:
+                    await app.state.inflight.acquire()
+                    asyncio.ensure_future(_forward_to_scheduler(rd))
+                queue.task_done()
+                continue
             can_batch = (settings.batch_size > 1
                          and hasattr(app.state.engine, "create_chat_completions"))
             while can_batch and len(batch) < settings.batch_size:
@@ -262,6 +284,52 @@ def create_app(engine=None, settings: Settings | None = None,
                     detail=f"Error during message generation: {str(e)}",
                 ) from e
 
+    async def _stream_task(rd):
+        try:
+            await _truncate_and_stream(rd, app.state.semaphore)
+        except Exception as e:  # noqa: BLE001 — surfaced on the SSE channel
+            logger.error("Error during streamed generation: %s", e)
+            try:
+                rd["stream_queue"].put_nowait(e)
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _forward_to_scheduler(rd):
+        """Continuous mode: one request → one scheduler lane, no barrier.
+        Holds one ``app.state.inflight`` permit (acquired by the consumer)."""
+        m = app.state.metrics
+        try:
+            try:
+                messages = truncate_messages_to_fit_context(
+                    rd["messages"], settings.max_context_tokens)
+                t0 = time.time()
+                answer = await asyncio.wrap_future(app.state.engine.submit(
+                    messages,
+                    temperature=settings.temperature,
+                    top_p=settings.top_p,
+                    frequency_penalty=settings.frequency_penalty,
+                    presence_penalty=settings.presence_penalty,
+                ))
+                m.observe("generation_seconds", time.time() - t0)
+                _observe_engine_timings(m)
+                result = _answer_to_text(answer, m)
+                err = None
+            except HTTPException as e:
+                result, err = None, e
+            except Exception as e:  # noqa: BLE001 — 500 semantics, api.py:76-78
+                logger.error("Error during message generation: %s", e)
+                result, err = None, HTTPException(
+                    status_code=500,
+                    detail=f"Error during message generation: {str(e)}")
+            if rd["future"].cancelled():
+                logger.info("Future cancelled during processing; result dropped.")
+            elif err is not None:
+                rd["future"].set_exception(err)
+            else:
+                rd["future"].set_result(result)
+        finally:
+            app.state.inflight.release()
+
     async def _truncate_and_stream(rd, semaphore):
         """Run one streaming generation, forwarding engine chunks to the
         handler's queue from the worker thread.  Mirrors the reference's
@@ -298,6 +366,9 @@ def create_app(engine=None, settings: Settings | None = None,
     async def startup_event():
         app.state.queue = asyncio.Queue(maxsize=settings.max_queue_size)
         app.state.semaphore = asyncio.Semaphore(1)
+        # continuous mode: at most batch_size forwarded-but-unfinished
+        # requests, so the bounded queue stays the back-pressure surface
+        app.state.inflight = asyncio.Semaphore(max(1, settings.batch_size))
         if app.state.engine is None:
             factory = engine_factory or _default_engine_factory(settings)
             loop = asyncio.get_running_loop()
@@ -434,7 +505,7 @@ def create_app(engine=None, settings: Settings | None = None,
 
 def _default_engine_factory(settings: Settings):
     def factory():
-        from ..engine import Engine, MeshEngine
+        from ..engine import ContinuousEngine, Engine, MeshEngine
 
         kw = dict(
             n_ctx=settings.max_context_tokens,
@@ -444,9 +515,15 @@ def _default_engine_factory(settings: Settings):
             max_gen_tokens=settings.max_gen_tokens,
             attn_impl=settings.attn_impl,
         )
+        if settings.scheduler not in ("continuous", "cycle"):
+            raise ValueError(
+                f"LFKT_SCHEDULER must be 'continuous' or 'cycle', "
+                f"got {settings.scheduler!r}")
         if settings.batch_size > 1:
-            eng = MeshEngine(settings.model_path, tp=settings.mesh_tp,
-                             batch_size=settings.batch_size, **kw)
+            cls = (ContinuousEngine if settings.scheduler == "continuous"
+                   else MeshEngine)
+            eng = cls(settings.model_path, tp=settings.mesh_tp,
+                      batch_size=settings.batch_size, **kw)
         else:
             eng = Engine(settings.model_path, **kw)
         eng.warmup()

@@ -49,11 +49,15 @@ class Settings:
     prefill_buckets: str = "128,256,512,1024"  # padded prompt shapes to bound recompiles
     weight_format: str = "auto"     # auto | bf16 | int8 | q4k
     attn_impl: str = "auto"         # auto | xla | pallas (prefill flash kernel)
-    # >1 switches the server to the MeshEngine batched path: the consumer
-    # coalesces up to batch_size queued requests per generation (FIFO
-    # preserved) — the v5e-4 "concurrent /response load" config.
+    # >1 switches the server to mesh-batched serving — the v5e-4
+    # "concurrent /response load" config.  scheduler picks the flavor:
+    #   cycle      — MeshEngine: coalesce up to batch_size queued requests
+    #                per generation cycle (barrier between cycles)
+    #   continuous — ContinuousEngine: slot-based continuous batching;
+    #                free lanes admit new requests at every chunk boundary
     batch_size: int = 1
-    mesh_tp: int = 1                # tensor-parallel width for MeshEngine
+    scheduler: str = "continuous"
+    mesh_tp: int = 1                # tensor-parallel width across the mesh
 
     @property
     def model_path(self) -> str:
@@ -84,5 +88,6 @@ def get_settings() -> Settings:
         weight_format=_env("LFKT_WEIGHT_FORMAT", Settings.weight_format),
         attn_impl=_env("LFKT_ATTN_IMPL", Settings.attn_impl),
         batch_size=_env("LFKT_BATCH_SIZE", Settings.batch_size, int),
+        scheduler=_env("LFKT_SCHEDULER", Settings.scheduler),
         mesh_tp=_env("LFKT_MESH_TP", Settings.mesh_tp, int),
     )
