@@ -1,6 +1,8 @@
 """Benchmark: Llama-3-8B decode throughput + prefill TTFT on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} — on
+success AND on failure (failure lines carry value 0.0 and an "error" field,
+so the driver always gets parseable output).
 
 The reference's engine (llama.cpp cuBLAS, reference docker/Dockerfile.base:30)
 publishes no numbers; the driver-provided target (BASELINE.md) is A10G-parity
@@ -8,10 +10,19 @@ decode throughput for Llama-3-8B Q4_K_M — llama.cpp-class engines decode
 Q4_K_M 8B on an A10G at roughly 30-60 tok/s; vs_baseline is computed against
 the 45 tok/s midpoint.
 
+Resilience (round-1 postmortem): the device tunnel is SINGLE-SESSION — a
+stale process holding it makes ``jax.devices()`` fail fast (UNAVAILABLE) or
+hang forever.  The parent process therefore never touches jax itself: it
+spawns the real bench as a child, enforces a backend-init deadline (the
+child reports init on stderr) and a total deadline, kills hung children,
+and retries with backoff.  Tune via LFKT_BENCH_ATTEMPTS (default 5),
+LFKT_BENCH_INIT_TIMEOUT (s, default 180), LFKT_BENCH_TOTAL_TIMEOUT
+(s, default 1500), LFKT_BENCH_BACKOFF (s, first gap, default 10, doubles).
+
 The model is the real 8B architecture (models/config.py LLAMA3_8B) with
-synthesized int8 weights (zero-egress environment: weights cannot be
-downloaded, and decode speed is value-independent — it is bound by HBM
-bytes/token, which synthetic weights reproduce exactly).
+synthesized weights (zero-egress environment: weights cannot be downloaded,
+and decode speed is value-independent — it is bound by HBM bytes/token,
+which synthetic weights reproduce exactly).
 
 Run standalone and ALONE (the device tunnel is single-session):
     python bench.py            # real chip, 8B
@@ -28,41 +39,22 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
+import threading
 import time
-
-import jax
-import numpy as np
-import jax.numpy as jnp
-
-if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-    # a site hook may pre-register the tunneled device platform and override
-    # the env var at startup; the post-import config update wins if no
-    # backend is initialized yet (same defense as tests/conftest.py)
-    jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B, ModelConfig  # noqa: E402
-from llama_fastapi_k8s_gpu_tpu.models.generate import (  # noqa: E402
-    generate_chunk_jit,
-    init_state,
-    prefill_jit,
-    sample_jit,
-)
-from llama_fastapi_k8s_gpu_tpu.sampling.sample import (  # noqa: E402
-    SamplingParams,
-    sampling_tensors,
-    seed_window,
-)
 
 A10G_Q4KM_8B_TOK_S = 45.0  # midpoint of the 30-60 tok/s llama.cpp A10G range
 
-TINY = ModelConfig(vocab_size=512, dim=128, n_layers=2, n_heads=8,
-                   n_kv_heads=4, ffn_dim=256, n_ctx=256)
+_INIT_MARK = "LFKT_INIT_OK"
 
 
-def synth_int8_device(cfg: ModelConfig, seed: int = 0, fmt: str = "int8") -> dict:
+# ---------------------------------------------------------------------------
+# child: the actual benchmark (runs with LFKT_BENCH_CHILD=1)
+# ---------------------------------------------------------------------------
+
+def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
     """Device-side random params (no multi-GB host RNG / transfer).
 
     ``fmt="int8"``: per-channel int8 (ops/linear.py).  ``fmt="q4k"``: the
@@ -70,6 +62,9 @@ def synth_int8_device(cfg: ModelConfig, seed: int = 0, fmt: str = "int8") -> dic
     + small scales; decode bandwidth is value-independent, so this measures
     exactly what real Q4_K weights would.
     """
+    import jax
+    import jax.numpy as jnp
+
     from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import TK, q4k_compatible
 
     kv_dim = cfg.n_kv_heads * cfg.head_dim
@@ -122,17 +117,50 @@ def synth_int8_device(cfg: ModelConfig, seed: int = 0, fmt: str = "int8") -> dic
     }
 
 
-def main():
+def child_main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # a site hook may pre-register the tunneled device platform and
+        # override the env var at startup; the post-import config update wins
+        # if no backend is initialized yet (same defense as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+
+    from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B, ModelConfig
+    from llama_fastapi_k8s_gpu_tpu.models.generate import (
+        generate_chunk_jit,
+        init_state,
+        prefill_jit,
+        sample_jit,
+    )
+    from llama_fastapi_k8s_gpu_tpu.sampling.sample import (
+        SamplingParams,
+        sampling_tensors,
+        seed_window,
+    )
+
+    tiny = ModelConfig(vocab_size=512, dim=128, n_layers=2, n_heads=8,
+                       n_kv_heads=4, ffn_dim=256, n_ctx=256)
+
     preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
     wfmt = os.environ.get("LFKT_BENCH_FMT", "int8")  # int8 | q4k
-    cfg = TINY if preset == "tiny" else LLAMA3_8B
-    prompt_len = 128
-    gen_tokens = int(os.environ.get("LFKT_BENCH_TOKENS", "256" if preset != "tiny" else "32"))
+    cfg = tiny if preset == "tiny" else LLAMA3_8B
+    prompt_len = int(os.environ.get("LFKT_BENCH_PROMPT", "128"))
+    gen_tokens = int(os.environ.get(
+        "LFKT_BENCH_TOKENS", "256" if preset != "tiny" else "32"))
     chunk = int(os.environ.get("LFKT_BENCH_CHUNK", "16"))
 
     dev = jax.devices()[0]
+    # tell the watchdog parent that backend init survived (the single-session
+    # tunnel hangs or faults here when another process holds the device)
+    print(f"{_INIT_MARK} {dev}", file=sys.stderr, flush=True)
+
     t0 = time.time()
-    params = synth_int8_device(cfg, fmt=wfmt)
+    params = synth_params_device(cfg, fmt=wfmt)
     # label honesty: report q4k only if any tensor actually got the layout
     if wfmt == "q4k" and not any(
             isinstance(v, dict) and "qs" in v
@@ -198,7 +226,190 @@ def main():
         "load_s": round(load_s, 1),
         "compile_s": round(compile_s, 1),
     }
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# parent: watchdog orchestrator (no jax import — must stay hang-proof)
+# ---------------------------------------------------------------------------
+
+def _preflight_warn() -> None:
+    """Best-effort stderr warning if another python process might hold the
+    single-session device tunnel (round-1 failure cause: a stale server)."""
+    try:
+        out = subprocess.run(
+            ["ps", "-eo", "pid,args"], capture_output=True, text=True,
+            timeout=5).stdout
+    except Exception:
+        return
+    me = os.getpid()
+    for line in out.splitlines():
+        parts = line.strip().split(None, 2)
+        if len(parts) < 3 or not parts[0].isdigit():
+            continue
+        pid, exe, rest = int(parts[0]), parts[1], parts[2]
+        if pid in (me, os.getppid()) or "python" not in os.path.basename(exe):
+            continue
+        if "-m llama_fastapi_k8s_gpu_tpu" in rest or "bench.py" in rest:
+            print(f"bench.py preflight: possible device-holding process: "
+                  f"{line.strip()[:160]}", file=sys.stderr, flush=True)
+
+
+def _kill(proc: subprocess.Popen) -> bool:
+    """Terminate the child; returns False if it survived SIGKILL (stuck in
+    uninterruptible I/O on the hung tunnel) — the caller must NOT spawn
+    another child against the single-session device in that case."""
+    for sig in (signal.SIGTERM, signal.SIGKILL):
+        if proc.poll() is not None:
+            return True
+        try:
+            proc.send_signal(sig)
+        except ProcessLookupError:
+            return True
+        try:
+            proc.wait(timeout=5)
+            return True
+        except subprocess.TimeoutExpired:
+            continue
+    return proc.poll() is not None
+
+
+def _run_attempt(init_timeout: float, total_timeout: float):
+    """One child run. Returns (json_line | None, error_str | None, retriable).
+
+    ``retriable=False`` means another attempt cannot help: either the child
+    failed deterministically (e.g. ImportError — fast exit with no backend
+    error in stderr) or it could not be killed and still holds the
+    single-session device tunnel."""
+    env = dict(os.environ, LFKT_BENCH_CHILD="1")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+
+    init_seen = threading.Event()
+    stdout_lines: list[str] = []
+    stderr_tail: list[str] = []
+
+    def read_out():
+        for line in proc.stdout:
+            line = line.strip()
+            if line:
+                stdout_lines.append(line)
+
+    def read_err():
+        for line in proc.stderr:
+            line = line.rstrip()
+            if _INIT_MARK in line:
+                init_seen.set()
+            stderr_tail.append(line)
+            del stderr_tail[:-40]
+
+    th_o = threading.Thread(target=read_out, daemon=True)
+    th_e = threading.Thread(target=read_err, daemon=True)
+    th_o.start(); th_e.start()
+
+    start = time.monotonic()
+    err = None
+    retriable = True
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            break
+        waited = time.monotonic() - start
+        if not init_seen.is_set() and waited > init_timeout:
+            err = (f"backend init did not complete within {init_timeout:.0f}s "
+                   f"(single-session device tunnel hung/held?)")
+            if not _kill(proc):
+                err += ("; child UNKILLABLE and still holds the device "
+                        "tunnel — not retrying")
+                retriable = False
+            break
+        if waited > total_timeout:
+            err = f"bench did not finish within {total_timeout:.0f}s"
+            if not _kill(proc):
+                err += ("; child UNKILLABLE and still holds the device "
+                        "tunnel — not retrying")
+                retriable = False
+            break
+        time.sleep(0.5)
+    th_o.join(timeout=5); th_e.join(timeout=5)
+
+    for line in reversed(stdout_lines):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return line, None, True
+        except ValueError:
+            continue
+    if err is None:
+        tail = " | ".join(stderr_tail[-6:])[-600:]
+        err = f"child exited rc={proc.poll()} without a result: {tail}"
+        # Deterministic Python failures (bad env var, ImportError, div-by-0)
+        # cannot be fixed by retrying; transient device faults (UNAVAILABLE —
+        # the round-1 failure mode — and friends) can.  Classify by stderr;
+        # an empty tail is ambiguous, so retry it.
+        transient = not tail or any(m in tail for m in (
+            "UNAVAILABLE", "Unavailable", "RESOURCE_EXHAUSTED", "DEADLINE",
+            "INTERNAL", "ABORTED", "initialize backend", "tunnel"))
+        retriable = transient
+    return None, err, retriable
+
+
+def main() -> None:
+    if os.environ.get("LFKT_BENCH_CHILD") == "1":
+        child_main()
+        return
+
+    def env_num(name: str, default: float) -> float:
+        # the parent must never die before printing its JSON line, so a
+        # malformed knob falls back to the default instead of raising
+        try:
+            return float(os.environ.get(name, default))
+        except ValueError:
+            print(f"bench.py: ignoring malformed {name}", file=sys.stderr)
+            return default
+
+    _preflight_warn()
+    attempts = max(1, int(env_num("LFKT_BENCH_ATTEMPTS", 5)))
+    init_timeout = env_num("LFKT_BENCH_INIT_TIMEOUT", 180)
+    total_timeout = env_num("LFKT_BENCH_TOTAL_TIMEOUT", 1500)
+    backoff = env_num("LFKT_BENCH_BACKOFF", 10)
+    # hard cap across ALL attempts+backoffs, so an external harness timeout
+    # can't kill the parent before the guaranteed JSON line is printed
+    deadline = time.monotonic() + env_num("LFKT_BENCH_DEADLINE", 3000)
+
+    errors: list[str] = []
+    for i in range(attempts):
+        if i:
+            gap = min(backoff * (2 ** (i - 1)),
+                      max(0.0, deadline - time.monotonic() - 60))
+            print(f"bench.py: attempt {i} failed ({errors[-1][:200]}); "
+                  f"retrying in {gap:.0f}s", file=sys.stderr, flush=True)
+            time.sleep(gap)
+        remaining = deadline - time.monotonic()
+        if remaining < 60:
+            errors.append(f"overall deadline reached after {i} attempt(s)")
+            break
+        line, err, retriable = _run_attempt(
+            min(init_timeout, remaining), min(total_timeout, remaining))
+        if line is not None:
+            print(line, flush=True)
+            return
+        errors.append(err or "unknown error")
+        if not retriable:
+            break
+
+    preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
+    wfmt = os.environ.get("LFKT_BENCH_FMT", "int8")
+    print(json.dumps({
+        "metric": f"decode_tokens_per_sec_per_chip[{preset},{wfmt},synthetic]",
+        "value": 0.0,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "error": f"{len(errors)} attempt(s) failed; last: {errors[-1][:500]}",
+        "attempts": len(errors),
+    }), flush=True)
+    sys.exit(1)  # failure JSON is on stdout either way; CI must see rc!=0
 
 
 if __name__ == "__main__":
