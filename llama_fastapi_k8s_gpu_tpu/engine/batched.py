@@ -163,8 +163,11 @@ class MeshEngine(Engine):
         # pad the batch with a minimal dummy prompt (static batch shape)
         ids_list += [dummy] * (B - n_real)
         if seed is None:
-            seed = self._base_seed + self._requests
-        self._requests += n_real
+            seed = self._next_seed()
+        else:
+            self._next_seed()
+        with self._id_lock:  # advance past the whole batch
+            self._requests += n_real - 1
 
         bucket = self._bucket_for(max(len(i) for i in ids_list))
         lengths = jnp.asarray([len(i) for i in ids_list], jnp.int32)
@@ -242,13 +245,14 @@ class MeshEngine(Engine):
         self._bstate = state                          # reuse buffers
         decode_s = time.time() - t0 - ttft
         total_new = sum(len(g) for g in gens[:n_real])
-        self.last_timings = {
+        timings = {
             "ttft_s": ttft, "decode_s": decode_s,
             "prompt_tokens": int(sum(len(i) for i in ids_list[:n_real])),
             "completion_tokens": total_new,
             "tokens_per_sec": (total_new - n_real) / decode_s
             if decode_s > 0 and total_new > n_real else 0.0,
         }
+        self._record_timings(timings)
 
         out = []
         for b in range(n_real):
@@ -263,6 +267,7 @@ class MeshEngine(Engine):
                 text = text[:cut]
                 finish = "stop"
             out.append({
+                "lfkt_timings": timings,  # batch-level (one shared cycle)
                 "id": f"chatcmpl-{uuid.uuid4().hex}",
                 "object": "chat.completion",
                 "created": int(time.time()),

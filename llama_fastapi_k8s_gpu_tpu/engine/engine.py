@@ -17,6 +17,7 @@ streaming chunks (SURVEY.md §2B) — on a JAX/TPU runtime:
 
 from __future__ import annotations
 
+import codecs
 import dataclasses
 import logging
 import threading
@@ -79,10 +80,17 @@ class Engine:
         self.max_gen_tokens = max_gen_tokens
         self._lock = threading.Lock()
         self._base_seed = seed
+        # request counter: shared by the serial path (caller thread) and the
+        # continuous scheduler thread; _next_seed() is the only writer and
+        # takes _id_lock so concurrent submitters never reuse a seed
+        self._id_lock = threading.Lock()
         self._requests = 0
         #: per-phase wall timings of the most recent completed request
         #: (ttft_s, decode_s, completion_tokens, tokens_per_sec) — the
         #: per-phase timers SURVEY.md §5 calls for; scraped into /metrics.
+        #: Written via _record_timings (atomic dict swap under _id_lock);
+        #: per-request timings also ride in each response dict under
+        #: "lfkt_timings" so callers never need this shared field.
         self.last_timings: dict | None = None
         _setup_compile_cache()
 
@@ -167,6 +175,16 @@ class Engine:
         logger.info("warmup done in %.1fs (%d prefill buckets)",
                     time.time() - t0, len(self.prefill_buckets))
 
+    def _next_seed(self) -> int:
+        with self._id_lock:
+            s = self._base_seed + self._requests
+            self._requests += 1
+            return s
+
+    def _record_timings(self, timings: dict) -> None:
+        with self._id_lock:
+            self.last_timings = timings
+
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
             if n <= b:
@@ -223,8 +241,9 @@ class Engine:
         st = sampling_tensors(sp)
 
         if seed is None:
-            seed = self._base_seed + self._requests
-        self._requests += 1
+            seed = self._next_seed()
+        else:
+            self._next_seed()  # keep the auto-seed sequence advancing
 
         logits, cache = prefill_jit(
             self.params, self.cfg, jnp.asarray(padded, jnp.int32),
@@ -248,12 +267,13 @@ class Engine:
             "ids": [], "first": first, "t0": t0, "ttft_s": time.time() - t0,
         }
 
-    def _finish(self, ctx):
-        """Return the cache buffer for reuse; finalize per-phase timings."""
+    def _finish(self, ctx) -> dict:
+        """Return the cache buffer for reuse; finalize per-phase timings.
+        Returns the timings dict (also published to :attr:`last_timings`)."""
         self._cache = ctx["state"]["cache"]
         decode_s = time.time() - ctx["t0"] - ctx["ttft_s"]
         n = len(ctx["ids"])
-        self.last_timings = {
+        timings = {
             "ttft_s": ctx["ttft_s"],
             "decode_s": decode_s,
             "prompt_tokens": ctx["n_prompt"],
@@ -261,6 +281,8 @@ class Engine:
             # first token came out of prefill; the decode phase produced n-1
             "tokens_per_sec": (n - 1) / decode_s if n > 1 and decode_s > 0 else 0.0,
         }
+        self._record_timings(timings)
+        return timings
 
     def _token_budget(self, max_tokens, n_prompt):
         budget = self.max_gen_tokens if max_tokens is None else max_tokens
@@ -278,6 +300,21 @@ class Engine:
                 cut = i
         return cut
 
+    @staticmethod
+    def _stop_prefix_holdback(text: str, stops) -> int:
+        """Length of the longest suffix of ``text`` that is a proper prefix
+        of a stop string.  Stream emission withholds it until the next chunk
+        resolves whether the stop completes — otherwise a stop spanning a
+        chunk boundary would leak its first characters to the client, making
+        streamed text diverge from the batch decode."""
+        best = 0
+        for s in stops:
+            for k in range(min(len(s) - 1, len(text)), best, -1):
+                if text.endswith(s[:k]):
+                    best = k
+                    break
+        return best
+
     def _next_steps(self, produced: int, pos: int, budget: int) -> int:
         """Size of the next decode chunk given host-tracked progress (no
         device sync: ``pos`` is n_prompt + decoded count, tracked on host)."""
@@ -294,11 +331,19 @@ class Engine:
         If a stop lands mid-chunk the speculative chunk's cache writes are
         harmless — attention masks by position and every request re-prefills
         and reseeds the sampler window, so stale slots are never read.
+
+        Text increments are produced by an incremental UTF-8 decoder over
+        the (append-only) token byte stream, so the streamed concatenation
+        is byte-identical to the one-shot decode even when a multi-byte
+        character spans a chunk boundary.
         """
         stop_ids = self.tokenizer.stop_ids
         budget = self._token_budget(max_tokens, ctx["n_prompt"])
         gen: list[int] = []
-        emitted = ""
+        dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
+        n_emitted = 0    # characters already yielded
+        sent_bytes = 0   # bytes already fed to the incremental decoder
+        held = ""        # decoded text withheld (possible stop-string prefix)
         finish = "length"
         first = ctx["first"]
         if budget <= 0:
@@ -309,7 +354,10 @@ class Engine:
             return
         gen.append(first)
 
-        pos = ctx["n_prompt"] + 1  # host-tracked cache position
+        # host-tracked cache position = the device's next-slot-to-write after
+        # prefill (state["pos"] == n_prompt); starting one higher made the
+        # capacity clamp in _next_steps a token stricter than pre-pipelining
+        pos = ctx["n_prompt"]
         n_cur = self._next_steps(len(gen), pos, budget)
         pending = None
         if n_cur > 0:
@@ -339,26 +387,27 @@ class Engine:
             if pending is None:
                 done = True
 
-            text = self._decode_text(gen)
+            bts = self.tokenizer.decode_bytes(gen)
+            text = bts.decode("utf-8", errors="replace")
             cut = self._find_stop_str(text, stops)
             if cut != -1:
-                text = text[:cut]
                 finish = "stop"
                 done = True
-            # hold back a trailing replacement char (partial UTF-8 sequence)
-            safe = text
-            if not done and safe.endswith("�"):
-                safe = safe[:-1]
-            if len(safe) > len(emitted):
-                yield safe[len(emitted):], False, finish
-                emitted = safe
+            elif not done:
+                held += dec.decode(bts[sent_bytes:])
+                sent_bytes = len(bts)
+                hold = self._stop_prefix_holdback(held, stops)
+                ready, held = held[:len(held) - hold], held[len(held) - hold:]
+                if ready:
+                    yield ready, False, finish
+                    n_emitted += len(ready)
 
         text = self._decode_text(gen)
         cut = self._find_stop_str(text, stops)
         if cut != -1:
             text = text[:cut]
         ctx["ids"] = gen
-        yield text[len(emitted):] if len(text) > len(emitted) else "", True, finish
+        yield text[n_emitted:] if len(text) > n_emitted else "", True, finish
 
     # ------------------------------------------------------------------
     def _generate(self, messages, sp, max_tokens, stops, seed) -> dict:
@@ -370,11 +419,12 @@ class Engine:
             for text, done, fr in self._run(ctx, max_tokens, stops):
                 parts.append(text)
                 finish = fr
-            self._finish(ctx)
+            timings = self._finish(ctx)
             content = "".join(parts)
             completion_tokens = len(ctx["ids"])
             logger.info("generation: %.2fs, finish=%s", time.time() - t0, finish)
             return {
+                "lfkt_timings": timings,
                 "id": f"chatcmpl-{uuid.uuid4().hex}",
                 "object": "chat.completion",
                 "created": int(time.time()),
@@ -408,11 +458,22 @@ class Engine:
                     }],
                 }
 
-            yield chunk({"role": "assistant"})
-            finish = "stop"
-            for text, done, fr in self._run(ctx, max_tokens, stops):
-                finish = fr
-                if text:
-                    yield chunk({"content": text})
-            self._finish(ctx)
-            yield chunk({}, finish=finish)
+            finished = False
+            try:
+                yield chunk({"role": "assistant"})
+                finish = "stop"
+                for text, done, fr in self._run(ctx, max_tokens, stops):
+                    finish = fr
+                    if text:
+                        yield chunk({"content": text})
+                timings = self._finish(ctx)
+                finished = True
+                final = chunk({}, finish=finish)
+                final["lfkt_timings"] = timings
+                yield final
+            finally:
+                if not finished:
+                    # generator closed early (client gone): _finish must
+                    # still run or self._cache would keep pointing at the
+                    # buffer prefill donated, poisoning the next request
+                    self._finish(ctx)

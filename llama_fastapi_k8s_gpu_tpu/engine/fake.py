@@ -15,10 +15,11 @@ import uuid
 
 class FakeEngine:
     def __init__(self, reply: str = "ok", delay: float = 0.0,
-                 fail: Exception | None = None):
+                 fail: Exception | None = None, chunk_delay: float = 0.0):
         self.reply = reply
         self.delay = delay
         self.fail = fail
+        self.chunk_delay = chunk_delay   # slow-drip streaming (deadline tests)
         self.calls: list[list[dict]] = []
         self._lock = threading.Lock()
 
@@ -56,6 +57,8 @@ class FakeEngine:
                    "choices": [{"index": 0, "delta": {"role": "assistant"},
                                 "finish_reason": None}]}
             for ch in content:
+                if self.chunk_delay:
+                    time.sleep(self.chunk_delay)
                 yield {**base, "object": "chat.completion.chunk",
                        "choices": [{"index": 0, "delta": {"content": ch},
                                     "finish_reason": None}]}

@@ -40,10 +40,17 @@ class SamplingParams:
 
 
 def sampling_tensors(sp: SamplingParams) -> dict:
-    """The traced (non-shape-affecting) knobs as a pytree of f32 scalars."""
+    """The traced (non-shape-affecting) knobs as a pytree of scalars.
+
+    ``top_k`` rides along as a *traced* int32 so batched/continuous engines
+    can serve per-request k values under one compiled program: the static
+    ``top_k`` argument of :func:`sample_chain` becomes a ceiling and the
+    traced value masks down to the requested k (llama.cpp semantics:
+    k <= 0 disables the truncation)."""
     return {
         "temperature": jnp.float32(sp.temperature),
         "top_p": jnp.float32(sp.top_p),
+        "top_k": jnp.int32(sp.top_k if sp.top_k > 0 else 1 << 30),
         "min_p": jnp.float32(sp.min_p),
         "frequency_penalty": jnp.float32(sp.frequency_penalty),
         "presence_penalty": jnp.float32(sp.presence_penalty),
@@ -77,6 +84,8 @@ def sample_chain(
 ) -> jax.Array:
     logits = apply_penalties(logits.astype(jnp.float32), window, st)
     vals, idx = jax.lax.top_k(logits, top_k)          # sorted desc
+    if "top_k" in st:                                 # per-request k ≤ static k
+        vals = jnp.where(jnp.arange(top_k) < st["top_k"], vals, -jnp.inf)
     probs = jax.nn.softmax(vals)                      # untempered, over candidates
     cum_excl = jnp.cumsum(probs) - probs
     keep = cum_excl < st["top_p"]                     # keeps the crossing token

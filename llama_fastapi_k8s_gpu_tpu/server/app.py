@@ -99,6 +99,16 @@ def create_app(engine=None, settings: Settings | None = None,
     app.state.engine = engine
     app.state.metrics = Metrics()
     app.state.ready = engine is not None
+    # strong refs to fire-and-forget tasks: the loop holds only weak refs,
+    # so an unreferenced task can be garbage-collected mid-flight (losing
+    # its inflight permit and stranding its caller)
+    app.state.bg_tasks = set()
+
+    def _spawn(coro) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        app.state.bg_tasks.add(task)
+        task.add_done_callback(app.state.bg_tasks.discard)
+        return task
 
     async def consumer():
         """Single drain task: strict FIFO, one generation *cycle* at a time
@@ -124,12 +134,14 @@ def create_app(engine=None, settings: Settings | None = None,
                 if rd["future"].cancelled():
                     logger.info("Future was cancelled before processing; skipping.")
                 elif "stream_queue" in rd:
-                    # engine-internal lock serializes streams; don't block
-                    # the consumer behind the whole stream generation
-                    asyncio.ensure_future(_stream_task(rd))
+                    # streams ride scheduler lanes concurrently with batched
+                    # requests; each holds an inflight permit so the bounded
+                    # queue (503) stays the back-pressure surface for them too
+                    await app.state.inflight.acquire()
+                    _spawn(_stream_task(rd))
                 else:
                     await app.state.inflight.acquire()
-                    asyncio.ensure_future(_forward_to_scheduler(rd))
+                    _spawn(_forward_to_scheduler(rd))
                 queue.task_done()
                 continue
             can_batch = (settings.batch_size > 1
@@ -190,8 +202,13 @@ def create_app(engine=None, settings: Settings | None = None,
             for _ in batch:
                 queue.task_done()
 
-    def _observe_engine_timings(m):
-        timings = getattr(app.state.engine, "last_timings", None)
+    def _observe_engine_timings(m, answer=None):
+        """Record per-phase engine timings: prefer the per-request values
+        attached to the response (no shared-state read-back); fall back to
+        the engine's last_timings for paths that predate the attachment."""
+        timings = answer.get("lfkt_timings") if isinstance(answer, dict) else None
+        if timings is None:
+            timings = getattr(app.state.engine, "last_timings", None)
         if timings:
             m.observe("engine_ttft_seconds", timings["ttft_s"])
             if timings["tokens_per_sec"]:
@@ -229,7 +246,7 @@ def create_app(engine=None, settings: Settings | None = None,
                     presence_penalty=settings.presence_penalty,
                 )
                 m.observe("generation_seconds", time.time() - t0)
-                _observe_engine_timings(m)
+                _observe_engine_timings(m, answer)
                 return _answer_to_text(answer, m)
             except HTTPException:
                 raise
@@ -263,7 +280,10 @@ def create_app(engine=None, settings: Settings | None = None,
                 m.observe("generation_seconds", time.time() - t0)
                 m.inc("batched_generations_total")
                 m.observe("batch_occupancy", len(batch_messages))
-                _observe_engine_timings(m)
+                _observe_engine_timings(
+                    m, next((a for a in answers
+                             if isinstance(a, dict) and "lfkt_timings" in a),
+                            None))
                 out = []
                 for answer in answers:
                     if isinstance(answer, dict) and "error" in answer:
@@ -285,33 +305,46 @@ def create_app(engine=None, settings: Settings | None = None,
                 ) from e
 
     async def _stream_task(rd):
+        """Continuous mode: stream via a scheduler lane (no global semaphore —
+        lanes already bound concurrency). Holds one inflight permit."""
         try:
-            await _truncate_and_stream(rd, app.state.semaphore)
+            await _truncate_and_stream(rd, None)
         except Exception as e:  # noqa: BLE001 — surfaced on the SSE channel
             logger.error("Error during streamed generation: %s", e)
             try:
                 rd["stream_queue"].put_nowait(e)
             except Exception:  # noqa: BLE001
                 pass
+        finally:
+            app.state.inflight.release()
 
     async def _forward_to_scheduler(rd):
         """Continuous mode: one request → one scheduler lane, no barrier.
-        Holds one ``app.state.inflight`` permit (acquired by the consumer)."""
+        Holds one ``app.state.inflight`` permit (acquired by the consumer).
+        If the client's future is cancelled (408 timeout / disconnect) the
+        lane is abandoned so it frees at the next chunk boundary instead of
+        decoding to budget."""
         m = app.state.metrics
         try:
             try:
                 messages = truncate_messages_to_fit_context(
                     rd["messages"], settings.max_context_tokens)
                 t0 = time.time()
-                answer = await asyncio.wrap_future(app.state.engine.submit(
+                engine = app.state.engine
+                engine_fut = engine.submit(
                     messages,
                     temperature=settings.temperature,
                     top_p=settings.top_p,
                     frequency_penalty=settings.frequency_penalty,
                     presence_penalty=settings.presence_penalty,
-                ))
+                )
+                if hasattr(engine, "abandon"):
+                    rd["future"].add_done_callback(
+                        lambda f: engine.abandon(engine_fut)
+                        if f.cancelled() else None)
+                answer = await asyncio.wrap_future(engine_fut)
                 m.observe("generation_seconds", time.time() - t0)
-                _observe_engine_timings(m)
+                _observe_engine_timings(m, answer)
                 result = _answer_to_text(answer, m)
                 err = None
             except HTTPException as e:
@@ -332,27 +365,46 @@ def create_app(engine=None, settings: Settings | None = None,
 
     async def _truncate_and_stream(rd, semaphore):
         """Run one streaming generation, forwarding engine chunks to the
-        handler's queue from the worker thread.  Mirrors the reference's
-        no-mid-generation-abort behavior: a disconnected client just stops
-        consuming; generation runs to completion and chunks are dropped."""
+        handler's queue from the worker thread.
+
+        ``semaphore=None`` (continuous mode) streams through a scheduler
+        lane with no global serialization.  When the client abandons the
+        stream (timeout/disconnect cancels ``rd["future"]``) the engine
+        iterator is closed, which frees the lane at the next chunk boundary;
+        serial engines instead run to completion with chunks dropped — the
+        reference's no-mid-generation-abort behavior (api.py:97-100), which
+        costs nobody there because its engine is serial anyway."""
         m = app.state.metrics
         chunk_q = rd["stream_queue"]
         loop = asyncio.get_running_loop()
-        async with semaphore:
+        timings_box: list = []
+
+        async def _go():
             messages = truncate_messages_to_fit_context(
                 rd["messages"], settings.max_context_tokens)
+            abandonable = hasattr(app.state.engine, "submit_stream")
 
             def run():
                 try:
-                    for chunk in app.state.engine.create_chat_completion(
-                            messages=messages,
-                            stream=True,
-                            temperature=settings.temperature,
-                            top_p=settings.top_p,
-                            frequency_penalty=settings.frequency_penalty,
-                            presence_penalty=settings.presence_penalty):
-                        loop.call_soon_threadsafe(chunk_q.put_nowait, chunk)
-                    loop.call_soon_threadsafe(chunk_q.put_nowait, _STREAM_DONE)
+                    it = app.state.engine.create_chat_completion(
+                        messages=messages,
+                        stream=True,
+                        temperature=settings.temperature,
+                        top_p=settings.top_p,
+                        frequency_penalty=settings.frequency_penalty,
+                        presence_penalty=settings.presence_penalty)
+                    try:
+                        for chunk in it:
+                            if abandonable and rd["future"].cancelled():
+                                return   # closes it → engine frees the lane
+                            t = chunk.pop("lfkt_timings", None)
+                            if t is not None:
+                                timings_box.append(t)
+                            loop.call_soon_threadsafe(chunk_q.put_nowait, chunk)
+                        loop.call_soon_threadsafe(
+                            chunk_q.put_nowait, _STREAM_DONE)
+                    finally:
+                        it.close()
                 except Exception as e:  # noqa: BLE001 — surfaced as SSE error
                     loop.call_soon_threadsafe(chunk_q.put_nowait, e)
 
@@ -360,7 +412,14 @@ def create_app(engine=None, settings: Settings | None = None,
             await asyncio.to_thread(run)
             m.observe("generation_seconds", time.time() - t0)
             m.inc("streamed_generations_total")
-            _observe_engine_timings(m)
+            _observe_engine_timings(
+                m, {"lfkt_timings": timings_box[0]} if timings_box else None)
+
+        if semaphore is None:
+            await _go()
+        else:
+            async with semaphore:
+                await _go()
 
     @app.on_event("startup")
     async def startup_event():
@@ -429,22 +488,29 @@ def create_app(engine=None, settings: Settings | None = None,
     async def generate_response_stream(request_body: BotMessageRequest,
                                        request: Request):
         """Streaming variant of ``/response`` (BASELINE config "streaming
-        completion"): same admission control (queue slot, 503 on overflow,
-        timeout per chunk-gap), same prompt assembly; emits server-sent
-        events with OpenAI chunk dicts, terminated by ``data: [DONE]``."""
+        completion"): same admission control (queue slot, 503 on overflow),
+        same prompt assembly; emits server-sent events with OpenAI chunk
+        dicts, terminated by ``data: [DONE]``.  Two timeouts bound the
+        stream: the per-chunk gap (timeout_seconds, like the non-stream 408)
+        AND a total wall-clock deadline (stream_deadline_seconds) so a
+        slow-dripping generation cannot hold its queue slot forever."""
         m = request.app.state.metrics
         rd = _admit(request_body, request,
                     extra={"stream_queue": asyncio.Queue()})
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + settings.stream_deadline_seconds
 
         async def sse():
             while True:
+                gap = min(settings.timeout_seconds, deadline - loop.time())
                 try:
+                    if gap <= 0:
+                        raise asyncio.TimeoutError
                     chunk = await asyncio.wait_for(
-                        rd["stream_queue"].get(),
-                        timeout=settings.timeout_seconds)
+                        rd["stream_queue"].get(), timeout=gap)
                 except asyncio.TimeoutError:
                     m.inc("requests_timed_out_total")
-                    rd["future"].cancel()
+                    rd["future"].cancel()   # abandons the lane (continuous)
                     yield ("data: "
                            + json.dumps({"error": "Generation timed out"})
                            + "\n\n")

@@ -75,8 +75,16 @@ class Tokenizer:
                 ids.extend(self._encode_fragment(segment))
         return ids
 
-    def decode(self, ids: Iterable[int], skip_special: bool = True) -> str:
+    def decode_bytes(self, ids: Iterable[int], skip_special: bool = True) -> bytes:
+        """Raw UTF-8 byte stream for ``ids``.  Unlike the decoded *text*,
+        the byte stream is append-only across incremental decodes — the
+        property streaming emission relies on (engines feed the new bytes
+        through an incremental UTF-8 decoder so streamed text always equals
+        the batch decode, even mid-multibyte-sequence)."""
         raise NotImplementedError
+
+    def decode(self, ids: Iterable[int], skip_special: bool = True) -> str:
+        return self.decode_bytes(ids, skip_special).decode("utf-8", errors="replace")
 
     def _encode_fragment(self, text: str) -> list[int]:
         raise NotImplementedError

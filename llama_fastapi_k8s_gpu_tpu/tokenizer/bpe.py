@@ -117,7 +117,7 @@ class BPETokenizer(Tokenizer):
                             ids.append(bid)
         return ids
 
-    def decode(self, ids: Iterable[int], skip_special: bool = True) -> str:
+    def decode_bytes(self, ids: Iterable[int], skip_special: bool = True) -> bytes:
         buf = bytearray()
         for tid in ids:
             ttype = self.token_types[tid]
@@ -136,4 +136,4 @@ class BPETokenizer(Tokenizer):
                     buf.extend(ch.encode("utf-8"))
                 else:
                     buf.append(b)
-        return buf.decode("utf-8", errors="replace")
+        return bytes(buf)

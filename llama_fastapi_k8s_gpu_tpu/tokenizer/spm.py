@@ -102,7 +102,7 @@ class SPMTokenizer(Tokenizer):
             i = nxt[i]
         return ids
 
-    def decode(self, ids: Iterable[int], skip_special: bool = True) -> str:
+    def decode_bytes(self, ids: Iterable[int], skip_special: bool = True) -> bytes:
         buf = bytearray()
         first_real = True
         for tid in ids:
@@ -121,4 +121,4 @@ class SPMTokenizer(Tokenizer):
                 text = text[1:]  # drop the dummy prefix space
             first_real = False
             buf.extend(text.encode("utf-8"))
-        return buf.decode("utf-8", errors="replace")
+        return bytes(buf)

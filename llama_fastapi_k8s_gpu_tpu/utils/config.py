@@ -31,6 +31,11 @@ class Settings:
     max_context_tokens: int = 1024
     timeout_seconds: float = 25.0
     max_queue_size: int = 5
+    # total wall-clock bound for one /response/stream response; the
+    # per-chunk-gap timeout alone would let a slow-dripping generation hold
+    # its queue slot indefinitely (no reference equivalent: it has no
+    # streaming at all, reference api.py:58)
+    stream_deadline_seconds: float = 300.0
 
     # Fixed sampling parameters the reference passes at api.py:59-62; the
     # remaining knobs take llama-cpp-python 0.2.77 defaults (top_k=40,
@@ -75,6 +80,8 @@ def get_settings() -> Settings:
         max_context_tokens=_env("LFKT_MAX_CONTEXT_TOKENS", Settings.max_context_tokens, int),
         timeout_seconds=_env("LFKT_TIMEOUT_SECONDS", Settings.timeout_seconds, float),
         max_queue_size=_env("LFKT_MAX_QUEUE_SIZE", Settings.max_queue_size, int),
+        stream_deadline_seconds=_env("LFKT_STREAM_DEADLINE_SECONDS",
+                                     Settings.stream_deadline_seconds, float),
         temperature=_env("LFKT_TEMPERATURE", Settings.temperature, float),
         top_p=_env("LFKT_TOP_P", Settings.top_p, float),
         frequency_penalty=_env("LFKT_FREQUENCY_PENALTY", Settings.frequency_penalty, float),
