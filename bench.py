@@ -143,13 +143,28 @@ def child_main() -> None:
         seed_window,
     )
 
+    import dataclasses
+
     tiny = ModelConfig(vocab_size=512, dim=128, n_layers=2, n_heads=8,
                        n_kv_heads=4, ffn_dim=256, n_ctx=256)
 
+    # Presets: tiny (CPU smoke) | llama3-8b (headline decode/TTFT) |
+    # llama3-8b-8k (long-context: 4k prompt into an 8k ring via the Pallas
+    # flash prefill kernel — the reference caps n_ctx at 1024, api.py:27).
     preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
     wfmt = os.environ.get("LFKT_BENCH_FMT", "int8")  # int8 | q4k
-    cfg = tiny if preset == "tiny" else LLAMA3_8B
-    prompt_len = int(os.environ.get("LFKT_BENCH_PROMPT", "128"))
+    if preset == "tiny":
+        cfg, p_def, ctx_def, attn_def = tiny, 128, tiny.n_ctx, "xla"
+    elif preset == "llama3-8b-8k":
+        cfg, p_def, ctx_def, attn_def = LLAMA3_8B, 4096, 8192, "pallas"
+    else:
+        cfg, p_def, ctx_def, attn_def = LLAMA3_8B, 128, LLAMA3_8B.n_ctx, "xla"
+    cfg = dataclasses.replace(
+        cfg,
+        n_ctx=int(os.environ.get("LFKT_BENCH_NCTX", ctx_def)),
+        attn_impl=os.environ.get("LFKT_BENCH_ATTN", attn_def),
+    )
+    prompt_len = int(os.environ.get("LFKT_BENCH_PROMPT", p_def))
     gen_tokens = int(os.environ.get(
         "LFKT_BENCH_TOKENS", "256" if preset != "tiny" else "32"))
     chunk = int(os.environ.get("LFKT_BENCH_CHUNK", "16"))
@@ -220,6 +235,8 @@ def child_main() -> None:
         "vs_baseline": round(tok_s / A10G_Q4KM_8B_TOK_S, 3),
         "ttft_ms_p50": round(ttft_ms, 1),
         "prompt_tokens": prompt_len,
+        "n_ctx": cfg.n_ctx,
+        "attn_impl": cfg.attn_impl,
         "gen_tokens": n_chunks * chunk,
         "decode_chunk": chunk,
         "device": str(dev),

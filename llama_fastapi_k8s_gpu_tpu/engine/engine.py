@@ -167,13 +167,21 @@ class Engine:
         for b in self.prefill_buckets[1:]:
             ids = [0] * (b - 1)
             cache = self._cache
-            logits, cache = prefill_jit(
-                self.params, self.cfg,
+            logits, cache = self._prefill_call(
                 jnp.asarray(ids + [0], jnp.int32)[:b], jnp.int32(len(ids)), cache)
             jax.block_until_ready(logits)
             self._cache = cache
         logger.info("warmup done in %.1fs (%d prefill buckets)",
                     time.time() - t0, len(self.prefill_buckets))
+
+    # -- jit call points (subclasses reroute these onto a mesh: engine/sp.py
+    # runs them sequence-parallel; the vmap/batched engines bypass them) ----
+    def _prefill_call(self, tokens, length, cache):
+        return prefill_jit(self.params, self.cfg, tokens, length, cache)
+
+    def _decode_chunk_call(self, state, st, n_steps: int, top_k: int):
+        return generate_chunk_jit(self.params, self.cfg, state, st,
+                                  n_steps=n_steps, top_k=top_k)
 
     def _next_seed(self) -> int:
         with self._id_lock:
@@ -245,10 +253,8 @@ class Engine:
         else:
             self._next_seed()  # keep the auto-seed sequence advancing
 
-        logits, cache = prefill_jit(
-            self.params, self.cfg, jnp.asarray(padded, jnp.int32),
-            jnp.int32(n_prompt), self._cache,
-        )
+        logits, cache = self._prefill_call(
+            jnp.asarray(padded, jnp.int32), jnp.int32(n_prompt), self._cache)
         window, wpos = seed_window(ids)
         key = jax.random.PRNGKey(seed)
         token, window, wpos, key = sample_jit(
@@ -361,9 +367,8 @@ class Engine:
         n_cur = self._next_steps(len(gen), pos, budget)
         pending = None
         if n_cur > 0:
-            ctx["state"], pending = generate_chunk_jit(
-                self.params, self.cfg, ctx["state"], ctx["st"],
-                n_steps=n_cur, top_k=ctx["sp"].top_k)
+            ctx["state"], pending = self._decode_chunk_call(
+                ctx["state"], ctx["st"], n_cur, ctx["sp"].top_k)
 
         done = pending is None
         while not done:
@@ -373,9 +378,8 @@ class Engine:
             n_nxt = self._next_steps(len(gen) + n_cur, pos, budget)
             nxt = None
             if n_nxt > 0:
-                ctx["state"], nxt = generate_chunk_jit(
-                    self.params, self.cfg, ctx["state"], ctx["st"],
-                    n_steps=n_nxt, top_k=ctx["sp"].top_k)
+                ctx["state"], nxt = self._decode_chunk_call(
+                    ctx["state"], ctx["st"], n_nxt, ctx["sp"].top_k)
 
             for t in np.asarray(pending).tolist():   # host sync, overlapped
                 if t in stop_ids:

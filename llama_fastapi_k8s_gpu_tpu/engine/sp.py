@@ -1,0 +1,71 @@
+"""Sequence-parallel serving engine: long context over an sp×tp mesh.
+
+The reference *suppresses* context (n_ctx=1024, 400-char clips, oldest-
+message eviction — reference api.py:27,37-46); this engine scales it
+instead: the KV cache's n_ctx dimension shards over the ``sp`` mesh axis and
+attention runs as ring attention for prefill / sharded-LSE for decode
+(parallel/ring.py), so no chip ever holds more than 1/sp of the KV.  Max
+context grows linearly with the ring size while the serving surface — the
+``create_chat_completion`` contract, streaming, admission control — stays
+exactly :class:`Engine`'s (only the two jit call points are rerouted onto
+the mesh).
+
+Enable from the server with ``LFKT_MESH_SP > 1`` (utils/config.py); combine
+with ``LFKT_MESH_TP`` for heads-sharded attention inside the ring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import jax
+
+from ..models.llama import init_cache
+from ..parallel.mesh import make_mesh, shard_params
+from ..parallel.ring import sp_generate_chunk, sp_prefill, sp_state_shardings
+from .engine import Engine
+
+logger = logging.getLogger(__name__)
+
+
+class SPEngine(Engine):
+    """An :class:`Engine` whose KV cache and attention are sequence-parallel.
+
+    Serial like the base engine (one generation at a time, the reference's
+    concurrency model); the mesh is spent on *context length*, not batch.
+    """
+
+    def __init__(self, model_path: str | None, *, sp: int = 2, tp: int = 1,
+                 n_ctx: int = 4096, **kw):
+        if sp < 2:
+            raise ValueError(f"SPEngine needs sp >= 2, got {sp} "
+                             f"(use Engine for single-chip serving)")
+        attn = kw.pop("attn_impl", "auto")
+        if attn not in ("auto", "ring"):
+            raise ValueError(
+                f"SPEngine serves ring attention; attn_impl must be "
+                f"auto|ring, got {attn!r}")
+        super().__init__(model_path, n_ctx=n_ctx, attn_impl="xla", **kw)
+        if self.cfg.n_ctx % sp:
+            raise ValueError(f"n_ctx {self.cfg.n_ctx} must divide sp={sp}")
+        self.mesh = make_mesh(dp=1, tp=tp, sp=sp)
+        self.sp = sp
+        self.params = shard_params(self.params, self.mesh)
+        self.cfg = dataclasses.replace(self.cfg, attn_impl="ring")
+        # ring prefill shards the token dim: buckets round up to sp multiples
+        self.prefill_buckets = sorted(
+            {min(self.cfg.n_ctx, -(-b // sp) * sp) for b in self.prefill_buckets})
+        self._cache = jax.device_put(
+            init_cache(self.cfg), sp_state_shardings(self.cfg, self.mesh))
+        logger.info("SPEngine: n_ctx=%d over sp=%d tp=%d (%d devices)",
+                    self.cfg.n_ctx, sp, tp, sp * tp)
+
+    # -- jit call points rerouted onto the mesh -----------------------------
+    def _prefill_call(self, tokens, length, cache):
+        return sp_prefill(self.params, self.cfg, tokens, length, cache,
+                          self.mesh)
+
+    def _decode_chunk_call(self, state, st, n_steps: int, top_k: int):
+        return sp_generate_chunk(self.params, self.cfg, state, st, self.mesh,
+                                 n_steps, top_k)

@@ -50,18 +50,11 @@ def sample_jit(logits, window, wpos, key, st, cfg: ModelConfig, top_k: int = 40)
     return token, window, wpos + 1, key
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "n_steps", "top_k"),
-    donate_argnames=("state",),
-)
-def generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
-                       n_steps: int, top_k: int = 40):
-    """Run ``n_steps`` decode+sample steps on device.
-
-    state["token"] is the most recently sampled (not yet decoded) token.
-    Returns (new_state, tokens (n_steps,)) — the tokens sampled this chunk.
-    """
+def generate_chunk(params, cfg: ModelConfig, state: dict, st: dict,
+                   n_steps: int, top_k: int = 40):
+    """Pure ``n_steps`` decode+sample scan (the body of
+    :func:`generate_chunk_jit`; parallel/ring.py re-jits it under a ring
+    context for sequence-parallel decode)."""
 
     def step(carry, _):
         logits, cache = forward(
@@ -81,3 +74,18 @@ def generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
         return new_carry, token
 
     return jax.lax.scan(step, state, None, length=n_steps)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "top_k"),
+    donate_argnames=("state",),
+)
+def generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
+                       n_steps: int, top_k: int = 40):
+    """Run ``n_steps`` decode+sample steps on device.
+
+    state["token"] is the most recently sampled (not yet decoded) token.
+    Returns (new_state, tokens (n_steps,)) — the tokens sampled this chunk.
+    """
+    return generate_chunk(params, cfg, state, st, n_steps, top_k)

@@ -181,9 +181,8 @@ def _pick_tn(n: int, interpret: bool) -> int:
     raise ValueError(f"N={n} not divisible by 128")
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _q4k_matmul_2d(xp: jax.Array, qs: jax.Array, sm: jax.Array,
-                   interpret: bool = False) -> jax.Array:
+def _q4k_2d_raw(xp: jax.Array, qs: jax.Array, sm: jax.Array,
+                interpret: bool) -> jax.Array:
     B, K = xp.shape
     N = qs.shape[0]
     TN = _pick_tn(N, interpret)
@@ -202,6 +201,69 @@ def _q4k_matmul_2d(xp: jax.Array, qs: jax.Array, sm: jax.Array,
     )(xp, qs, sm)
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _q4k_matmul_2d(xp: jax.Array, qs: jax.Array, sm: jax.Array,
+                   interpret: bool = False) -> jax.Array:
+    return _q4k_2d_raw(xp, qs, sm, interpret)
+
+
+def _spec_axis(sharding, dim: int):
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    return spec[dim] if dim < len(spec) else None
+
+
+@functools.lru_cache(maxsize=4)
+def _q4k_2d_partitioned(interpret: bool):
+    """The 2D fused matmul with a GSPMD partitioning rule: tp-sharded
+    ``qs``/``sm`` (N dim) compute locally and the output comes back N-sharded
+    — no all-gather of the quantized weights (VERDICT r1 #5; previously a
+    sharded ``qs`` was gathered at the pallas_call, defeating tp's per-chip
+    HBM purpose for exactly the format built to save bandwidth).
+
+    Contract: partitioning is over the output dim N (and the row/batch dim
+    of ``xp``); the contraction dim K is never split (mesh.py shards fused
+    weights on N for row-parallel layers too — gathering the small
+    activations beats gathering weights)."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @custom_partitioning
+    def fn(xp, qs, sm):
+        return _q4k_2d_raw(xp, qs, sm, interpret)
+
+    def partition(mesh, arg_shapes, result_shape):
+        xp_s, qs_s, sm_s = (a.sharding for a in arg_shapes)
+        rows = _spec_axis(xp_s, 0)
+        n_ax = _spec_axis(qs_s, 0)
+        arg_shardings = (
+            NamedSharding(mesh, P(rows, None)),        # never split K
+            NamedSharding(mesh, P(n_ax, None)),
+            NamedSharding(mesh, P(None, n_ax, None)),
+        )
+        result_sharding = NamedSharding(mesh, P(rows, n_ax))
+
+        def lower(xp, qs, sm):
+            return _q4k_2d_raw(xp, qs, sm, interpret)
+
+        return mesh, lower, result_sharding, arg_shardings
+
+    def infer(mesh, arg_shapes, result_shape):
+        return NamedSharding(
+            mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
+                    _spec_axis(arg_shapes[1].sharding, 0)))
+
+    fn.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        # shardy factor rule: rows (b) and output (n) propagate; K factors
+        # (k, j, t) stay unsplit by construction of the mesh.py shardings
+        sharding_rule="b k, n j, t n l -> b n",
+    )
+    return jax.jit(fn)
+
+
 _MAX_B = 128  # rows per kernel call: bounds the xp/out VMEM blocks (the
               # weight tiles dominate; a (128, 2048) bf16 xp block is 512 KiB)
 
@@ -216,16 +278,17 @@ def q4k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
     lead = x.shape[:-1]
     xp = permute_x(x).reshape(-1, K).astype(jnp.bfloat16)
     itp = _interpret(interpret)
+    fn = _q4k_2d_partitioned(itp)
     B = xp.shape[0]
     if B <= _MAX_B:
-        y = _q4k_matmul_2d(xp, w["qs"], w["sm"], interpret=itp)
+        y = fn(xp, w["qs"], w["sm"])
     else:
         pad = (-B) % _MAX_B
         if pad:
             xp = jnp.concatenate(
                 [xp, jnp.zeros((pad, K), xp.dtype)], axis=0)
         chunks = [
-            _q4k_matmul_2d(xp[i:i + _MAX_B], w["qs"], w["sm"], interpret=itp)
+            fn(xp[i:i + _MAX_B], w["qs"], w["sm"])
             for i in range(0, B + pad, _MAX_B)
         ]
         y = jnp.concatenate(chunks, axis=0)[:B]

@@ -52,8 +52,13 @@ def _linear_sharding(mesh: Mesh, col_parallel: bool) -> dict:
 
     Column-parallel (wq/wk/wv/w_gate/w_up): shard the output dim.
     Row-parallel (wo/w_down): shard the input dim; XLA inserts the psum.
-    (The fused-Q4_K pallas_call has no GSPMD partitioning rule yet, so a
-    sharded qs is all-gathered at the call — correct, not yet ICI-optimal.)
+
+    Fused Q4_K shards its OUTPUT dim in both cases: the pallas matmul
+    partitions over N (custom_partitioning in ops/pallas/qmatmul.py) but
+    never over the contraction dim (K tiles are 2048-wide and e.g. ffn_down's
+    7 tiles don't divide tp) — and for row-parallel layers, all-gathering the
+    small activations beats all-gathering the quantized weights by ~3 orders
+    of magnitude at decode (B=1: KBs of activations vs GBs of weights).
     """
     if col_parallel:
         return {"w": _ns(mesh, None, "tp", None),
@@ -64,10 +69,8 @@ def _linear_sharding(mesh: Mesh, col_parallel: bool) -> dict:
     return {"w": _ns(mesh, None, None, "tp"),
             "q": _ns(mesh, None, None, "tp"),
             "s": _ns(mesh, None, None),
-            "qs": _ns(mesh, None, None, "tp"),
-            # sm's k-tile count (K/2048, e.g. 7 for ffn_down) need not divide
-            # tp; replicate — it is only 1 bit/weight of the total
-            "sm": _ns(mesh, None, None, None, None)}
+            "qs": _ns(mesh, None, "tp", None),
+            "sm": _ns(mesh, None, None, "tp", None)}
 
 
 def _match_linear(shardings: dict, linear: dict) -> dict:
@@ -144,8 +147,39 @@ def _fit_sharding(arr, ns: NamedSharding) -> NamedSharding:
     return NamedSharding(mesh, P(*fixed))
 
 
+def _fit_q4k(leaf: dict, shard: dict) -> dict:
+    """Fused-Q4_K leaves: keep the N sharding only if every local shard
+    still satisfies the kernel's N tiling (128 sublanes on TPU, 8 in
+    interpret mode); otherwise replicate the whole leaf — a half-sharded
+    {qs, sm} pair would just reshard inside the partition rule."""
+    from ..ops.pallas import use_interpret
+
+    gran = 8 if use_interpret() else 128
+    qs = leaf["qs"]
+    ns = shard["qs"]
+    n_dim = qs.ndim - 2                      # (L, N, K/2) or (N, K/2)
+    spec = list(ns.spec) + [None] * (qs.ndim - len(ns.spec))
+    axes = spec[n_dim]
+    keep = True
+    if axes is not None:
+        names = (axes,) if isinstance(axes, str) else tuple(axes)
+        size = int(np.prod([ns.mesh.shape[a] for a in names]))
+        N = qs.shape[n_dim]
+        keep = N % size == 0 and (N // size) % gran == 0
+    if keep:
+        return {k: _fit_sharding(leaf[k], shard[k]) for k in leaf}
+    return {k: NamedSharding(ns.mesh, P(*([None] * leaf[k].ndim)))
+            for k in leaf}
+
+
 def fit_shardings(params: dict, shardings: dict) -> dict:
-    return jax.tree.map(_fit_sharding, params, shardings)
+    def fit(p, s):
+        if isinstance(p, dict) and "qs" in p:
+            return _fit_q4k(p, s)
+        return jax.tree.map(_fit_sharding, p, s)
+
+    return jax.tree.map(fit, params, shardings,
+                        is_leaf=lambda x: isinstance(x, dict) and "qs" in x)
 
 
 def shard_params(params: dict, mesh: Mesh) -> dict:

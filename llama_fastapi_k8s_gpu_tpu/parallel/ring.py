@@ -219,6 +219,22 @@ def sp_state_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
     return {"k": s, "v": s}
 
 
+def sp_gen_state_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
+    """Shardings for the full generation-state pytree
+    (models.generate.init_state) under sequence parallelism: the KV cache
+    seq-sharded over ``sp``, everything else replicated."""
+    rep = NamedSharding(mesh, P())
+    vec = NamedSharding(mesh, P(None))
+    return {
+        "cache": sp_state_shardings(cfg, mesh),
+        "pos": rep,
+        "token": rep,
+        "window": vec,
+        "wpos": rep,
+        "key": vec,
+    }
+
+
 @functools.lru_cache(maxsize=32)
 def _sp_prefill_fn(mesh: Mesh, axis_name: str, cfg: ModelConfig):
     """jit'd ring prefill, keyed on (mesh, axis, cfg) so a compiled program
@@ -262,3 +278,27 @@ def sp_decode_step(params, cfg: ModelConfig, token, pos, cache, mesh: Mesh,
     """One decode step against a seq-sharded cache (sharded-LSE attention);
     the cache is donated, so steady-state decode is allocation-free."""
     return _sp_decode_fn(mesh, axis_name, cfg)(params, token, pos, cache)
+
+
+@functools.lru_cache(maxsize=64)
+def _sp_chunk_fn(mesh: Mesh, axis_name: str, cfg: ModelConfig,
+                 n_steps: int, top_k: int):
+    from ..models.generate import generate_chunk
+
+    cfg = dataclasses.replace(cfg, attn_impl="ring")
+
+    def fn(params, state, st):
+        with ring_context(mesh, axis_name):
+            return generate_chunk(params, cfg, state, st, n_steps, top_k)
+
+    return jax.jit(fn, donate_argnames=("state",))
+
+
+def sp_generate_chunk(params, cfg: ModelConfig, state: dict, st: dict,
+                      mesh: Mesh, n_steps: int, top_k: int = 40,
+                      axis_name: str = "sp"):
+    """``n_steps`` on-device decode+sample steps with sharded-LSE attention
+    against the seq-sharded cache — the serving decode loop of the
+    sequence-parallel engine (engine/sp.py).  State is donated; the sampled
+    tokens (n_steps,) come back replicated."""
+    return _sp_chunk_fn(mesh, axis_name, cfg, n_steps, top_k)(params, state, st)

@@ -571,7 +571,7 @@ def create_app(engine=None, settings: Settings | None = None,
 
 def _default_engine_factory(settings: Settings):
     def factory():
-        from ..engine import ContinuousEngine, Engine, MeshEngine
+        from ..engine import ContinuousEngine, Engine, MeshEngine, SPEngine
 
         kw = dict(
             n_ctx=settings.max_context_tokens,
@@ -585,7 +585,15 @@ def _default_engine_factory(settings: Settings):
             raise ValueError(
                 f"LFKT_SCHEDULER must be 'continuous' or 'cycle', "
                 f"got {settings.scheduler!r}")
-        if settings.batch_size > 1:
+        if settings.mesh_sp > 1:
+            # long-context serving: n_ctx sharded over the sp ring
+            if settings.batch_size > 1:
+                raise ValueError(
+                    "LFKT_MESH_SP > 1 serves sequence-parallel (serial); "
+                    "set LFKT_BATCH_SIZE=1 or use dp/tp batching instead")
+            eng = SPEngine(settings.model_path, sp=settings.mesh_sp,
+                           tp=settings.mesh_tp, **kw)
+        elif settings.batch_size > 1:
             cls = (ContinuousEngine if settings.scheduler == "continuous"
                    else MeshEngine)
             eng = cls(settings.model_path, tp=settings.mesh_tp,

@@ -63,6 +63,11 @@ class Settings:
     batch_size: int = 1
     scheduler: str = "continuous"
     mesh_tp: int = 1                # tensor-parallel width across the mesh
+    # >1 serves with the sequence-parallel engine (engine/sp.py): the KV
+    # cache's n_ctx dim shards over an sp-axis ring (ring attention for
+    # prefill, sharded-LSE decode), scaling max context linearly with the
+    # ring size.  Serial serving (batch_size must stay 1).
+    mesh_sp: int = 1
 
     @property
     def model_path(self) -> str:
@@ -97,4 +102,5 @@ def get_settings() -> Settings:
         batch_size=_env("LFKT_BATCH_SIZE", Settings.batch_size, int),
         scheduler=_env("LFKT_SCHEDULER", Settings.scheduler),
         mesh_tp=_env("LFKT_MESH_TP", Settings.mesh_tp, int),
+        mesh_sp=_env("LFKT_MESH_SP", Settings.mesh_sp, int),
     )
