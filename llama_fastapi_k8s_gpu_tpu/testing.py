@@ -33,6 +33,49 @@ def byte_vocab_with_specials() -> tuple[list[str], list[int]]:
     return tokens, types
 
 
+LLAMA3_CHAT_TEMPLATE = (
+    "{{bos_token}}{% for m in messages %}<|start_header_id|>{{m['role']}}"
+    "<|end_header_id|>\n\n{{m['content']}}<|eot_id|>{% endfor %}"
+)
+
+
+def write_llama_gguf_meta(
+    w: GGUFWriter,
+    cfg: ModelConfig,
+    tokens: list[str],
+    types: list[int],
+    merges: list[str] | None = None,
+    name: str = "tiny-llama-test",
+    n_ctx: int | None = None,
+    chat_template: str | None = LLAMA3_CHAT_TEMPLATE,
+) -> None:
+    """The llama-architecture GGUF metadata block (hparams + BPE tokenizer)
+    shared by the tiny test fixture and the full-size cold-start bench."""
+    w.add_metadata("general.architecture", "llama")
+    w.add_metadata("general.name", name)
+    w.add_metadata("llama.block_count", cfg.n_layers)
+    w.add_metadata("llama.context_length", n_ctx or cfg.n_ctx)
+    w.add_metadata("llama.embedding_length", cfg.dim)
+    w.add_metadata("llama.feed_forward_length", cfg.ffn_dim)
+    w.add_metadata("llama.attention.head_count", cfg.n_heads)
+    w.add_metadata("llama.attention.head_count_kv", cfg.n_kv_heads)
+    w.add_metadata("llama.attention.layer_norm_rms_epsilon", cfg.rms_eps)
+    w.add_metadata("llama.rope.freq_base", cfg.rope_theta)
+    w.add_metadata("llama.vocab_size", cfg.vocab_size)
+    if cfg.sliding_window:
+        w.add_metadata("llama.attention.sliding_window", cfg.sliding_window)
+    w.add_metadata("tokenizer.ggml.model", "gpt2")
+    w.add_metadata("tokenizer.ggml.pre", "llama-bpe")
+    w.add_metadata("tokenizer.ggml.tokens", tokens)
+    w.add_metadata("tokenizer.ggml.token_type", types)
+    w.add_metadata("tokenizer.ggml.merges", list(merges or []))
+    w.add_metadata("tokenizer.ggml.bos_token_id",
+                   tokens.index("<|begin_of_text|>"))
+    w.add_metadata("tokenizer.ggml.eos_token_id", tokens.index("<|eot_id|>"))
+    if chat_template:
+        w.add_metadata("tokenizer.chat_template", chat_template)
+
+
 def write_tiny_llama_gguf(
     path: str,
     cfg: ModelConfig = TINY_CFG,
@@ -50,31 +93,7 @@ def write_tiny_llama_gguf(
     scale = cfg.dim ** -0.5
 
     w = GGUFWriter(path)
-    w.add_metadata("general.architecture", "llama")
-    w.add_metadata("general.name", "tiny-llama-test")
-    w.add_metadata("llama.block_count", cfg.n_layers)
-    w.add_metadata("llama.context_length", cfg.n_ctx)
-    w.add_metadata("llama.embedding_length", cfg.dim)
-    w.add_metadata("llama.feed_forward_length", cfg.ffn_dim)
-    w.add_metadata("llama.attention.head_count", cfg.n_heads)
-    w.add_metadata("llama.attention.head_count_kv", cfg.n_kv_heads)
-    w.add_metadata("llama.attention.layer_norm_rms_epsilon", cfg.rms_eps)
-    w.add_metadata("llama.rope.freq_base", cfg.rope_theta)
-    w.add_metadata("llama.vocab_size", cfg.vocab_size)
-    if cfg.sliding_window:
-        w.add_metadata("llama.attention.sliding_window", cfg.sliding_window)
-    w.add_metadata("tokenizer.ggml.model", "gpt2")
-    w.add_metadata("tokenizer.ggml.pre", "llama-bpe")
-    w.add_metadata("tokenizer.ggml.tokens", tokens)
-    w.add_metadata("tokenizer.ggml.token_type", types)
-    w.add_metadata("tokenizer.ggml.merges", [])
-    w.add_metadata("tokenizer.ggml.bos_token_id", tokens.index("<|begin_of_text|>"))
-    w.add_metadata("tokenizer.ggml.eos_token_id", tokens.index("<|eot_id|>"))
-    w.add_metadata(
-        "tokenizer.chat_template",
-        "{{bos_token}}{% for m in messages %}<|start_header_id|>{{m['role']}}"
-        "<|end_header_id|>\n\n{{m['content']}}<|eot_id|>{% endfor %}",
-    )
+    write_llama_gguf_meta(w, cfg, tokens, types)
 
     if ffn_quant is None:
         ffn_quant = quant
@@ -99,6 +118,65 @@ def write_tiny_llama_gguf(
     t("output.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
     w.write()
     return cfg
+
+
+def synth_bpe_vocab(n_merges: int = 280_000, seed: int = 0,
+                    ) -> tuple[list[str], list[str], list[int]]:
+    """Deterministic Llama-3-*scale* BPE vocab: 256 byte tokens + specials +
+    ``n_merges`` merge rules (~the real 128k-token / 280k-merge table's order
+    of magnitude, which the reference's tokenizer runs through llama.cpp —
+    reference api.py:56-57).  Returns (tokens, merges, token_types).
+
+    Construction (all seeded, no I/O):
+    - a *doubling chain* over "ab" (ab, abab, ...·2) so a long unbroken
+      letter run exercises ~log-depth cascading merges — the shape that made
+      the round-2 O(n²)-per-merge loop a latency cliff;
+    - all 26² lowercase pairs, then seeded random concatenations of existing
+      tokens (capped length) until ``n_merges`` rules exist.
+    """
+    rng = np.random.default_rng(seed)
+    b2u = bytes_to_unicode()
+    base = [b2u[b] for b in range(256)]
+    tokens: list[str] = list(base)
+    token_set = set(tokens)
+    pair_set: set[tuple[str, str]] = set()
+    merges: list[str] = []
+
+    def add_merge(left: str, right: str) -> None:
+        if (left, right) in pair_set:
+            return
+        pair_set.add((left, right))
+        merges.append(f"{left} {right}")
+        merged = left + right
+        if merged not in token_set:
+            token_set.add(merged)
+            tokens.append(merged)
+
+    cur = "ab"
+    add_merge("a", "b")
+    while len(cur) < 8192:
+        add_merge(cur, cur)
+        cur += cur
+    for a in "abcdefghijklmnopqrstuvwxyz":
+        for b in "abcdefghijklmnopqrstuvwxyz":
+            add_merge(a, b)
+    # bulk: seeded random concatenations of existing tokens (drawn from the
+    # earlier/shorter end so chains stay plausible), capped length
+    while len(merges) < n_merges:
+        n_tok = len(tokens)
+        li = rng.integers(0, min(n_tok, 60_000), size=4096)
+        ri = rng.integers(0, min(n_tok, 60_000), size=4096)
+        for i, j in zip(li, ri):
+            left, right = tokens[int(i)], tokens[int(j)]
+            if len(left) + len(right) > 24:
+                continue
+            add_merge(left, right)
+            if len(merges) >= n_merges:
+                break
+    tokens.extend(LLAMA3_SPECIALS)
+    types = [int(TokenType.NORMAL)] * (len(tokens) - len(LLAMA3_SPECIALS)) \
+        + [int(TokenType.CONTROL)] * len(LLAMA3_SPECIALS)
+    return tokens, merges, types
 
 
 def spm_byte_vocab() -> tuple[list[str], list[int], list[float]]:

@@ -9,6 +9,7 @@ GGUF metadata (``tokenizer.ggml.tokens`` / ``tokenizer.ggml.merges``).
 from __future__ import annotations
 
 import functools
+import heapq
 from typing import Iterable, Sequence
 
 import regex  # third-party 'regex' module: supports \p{L} classes
@@ -83,23 +84,49 @@ class BPETokenizer(Tokenizer):
 
     # ------------------------------------------------------------------
     def _bpe_merge(self, symbols: list[str]) -> list[str]:
-        """Merge adjacent symbol pairs in rank order until no merge applies."""
-        if len(symbols) < 2:
+        """Merge adjacent symbol pairs in rank order until no merge applies.
+
+        Heap + neighbor links (the same O(n log n) bigram queue llama.cpp's
+        ``llm_tokenizer_bpe`` uses, and that :mod:`spm` uses score-ordered):
+        pop the lowest-rank pair (ties → leftmost), splice, and only re-rank
+        the two pairs the splice created.  Stale heap entries are detected by
+        comparing the recorded symbols against the current ones.  The round-2
+        version rescanned the whole fragment per merge — O(n²) per fragment,
+        which a 280k-merge real vocab turns into a latency cliff on long
+        unbroken fragments."""
+        n = len(symbols)
+        if n < 2:
             return symbols
-        while True:
-            best_rank = None
-            best_i = -1
-            for i in range(len(symbols) - 1):
-                rank = self.merge_ranks.get((symbols[i], symbols[i + 1]))
-                if rank is not None and (best_rank is None or rank < best_rank):
-                    best_rank, best_i = rank, i
-            if best_rank is None:
-                return symbols
-            symbols = (
-                symbols[:best_i]
-                + [symbols[best_i] + symbols[best_i + 1]]
-                + symbols[best_i + 2:]
-            )
+        ranks = self.merge_ranks
+        prev = list(range(-1, n - 1))
+        nxt = list(range(1, n + 1))
+        alive = [True] * n
+        heap: list[tuple[int, int, int, str, str]] = []
+
+        def push(i: int):
+            j = nxt[i]
+            if j >= n:
+                return
+            rank = ranks.get((symbols[i], symbols[j]))
+            if rank is not None:
+                heapq.heappush(heap, (rank, i, j, symbols[i], symbols[j]))
+
+        for i in range(n - 1):
+            push(i)
+
+        while heap:
+            _, i, j, si, sj = heapq.heappop(heap)
+            if not alive[i] or not alive[j] or symbols[i] != si or symbols[j] != sj:
+                continue
+            symbols[i] = si + sj
+            alive[j] = False
+            nxt[i] = nxt[j]
+            if nxt[j] < n:
+                prev[nxt[j]] = i
+            if prev[i] >= 0:
+                push(prev[i])
+            push(i)
+        return [s for s, a in zip(symbols, alive) if a]
 
     def _encode_fragment(self, text: str) -> list[int]:
         ids: list[int] = []

