@@ -28,6 +28,7 @@ import os
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 A10G_TTFT_MS = 300.0  # BASELINE.md: p50 TTFT < 300 ms on /response
@@ -150,7 +151,39 @@ def main() -> None:
         ttft.append(first if first is not None
                     else (time.perf_counter() - t0) * 1e3)
 
-    lat.sort(); ttft.sort()
+    # concurrent load (BASELINE config #5: "concurrent /response load ...
+    # back-pressure"): fan out parallel POSTs; the server queues up to 5 and
+    # 503s beyond (reference api.py:113,158-160 semantics preserved)
+    # default 8 > queue(5)+1 in service, so the 503 path actually fires
+    conc = int(os.environ.get("LFKT_BENCH_CONCURRENCY", "8"))
+    per = max(2, n_req // 2)
+    oks, rejects, errors = [], [], []
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(per):
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(post("/response"), timeout=600) as r:
+                    r.read()
+                with lock:
+                    oks.append((time.perf_counter() - t0) * 1e3)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    (rejects if e.code == 503 else errors).append(e.code)
+            except Exception as e:  # noqa: BLE001 — connection-level failure:
+                with lock:          # count it, keep the sample sizes honest
+                    errors.append(type(e).__name__)
+
+    t_conc = time.perf_counter()
+    ths = [threading.Thread(target=worker) for _ in range(conc)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    conc_s = time.perf_counter() - t_conc
+
+    lat.sort(); ttft.sort(); oks.sort()
     p = lambda v, q: v[min(len(v) - 1, int(q * len(v)))]  # noqa: E731
     result = {
         "metric": f"server_ttft_ms_p50[/response,{preset},{wfmt}]",
@@ -163,6 +196,12 @@ def main() -> None:
         "max_tokens": max_tokens,
         "n_requests": n_req,
         "warmup_s": round(warm_s, 1),
+        "concurrent": {
+            "threads": conc, "completed": len(oks), "rejected_503": len(rejects),
+            "other_errors": len(errors),
+            "latency_ms_p95": round(p(oks, 0.95), 1) if oks else None,
+            "req_per_sec": round(len(oks) / conc_s, 2) if conc_s > 0 else None,
+        },
         "device": str(dev),
     }
     print(json.dumps(result), flush=True)

@@ -76,35 +76,45 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     base_fmt = "int8" if fmt == "q4k" else fmt
     make = _LINEAR_MAKERS[base_fmt]
 
-    def _q4k_names() -> set[str]:
-        """Linear positions where ALL layers are fused-kernel-eligible."""
+    def _fused_names() -> dict[str, object]:
+        """Linear positions where ALL layers share one fused-kernel-eligible
+        quantized type (Q4_K or Q6_K — Q4_K_M files mix both; a name whose
+        layers mix types falls back to int8 because stacked scan params need
+        one layout per name)."""
         from ..gguf.constants import GGMLType
         from ..ops.pallas.qmatmul import q4k_compatible
 
+        fusable = (GGMLType.Q4_K, GGMLType.Q6_K)
         names = ["attn_q", "attn_k", "attn_v", "attn_output",
                  "ffn_gate", "ffn_up", "ffn_down"]
-        ok = set()
+        ok: dict[str, object] = {}
         for n in names:
             ts = [gf[f"blk.{i}.{n}.weight"] for i in range(cfg.n_layers)]
-            if all(t.ggml_type == GGMLType.Q4_K
-                   and q4k_compatible(*reversed(t.shape)) for t in ts):
-                ok.add(n)
+            t0 = ts[0].ggml_type
+            if t0 in fusable and all(
+                    t.ggml_type == t0 and q4k_compatible(*reversed(t.shape))
+                    for t in ts):
+                ok[n] = t0
         t = gf.tensors.get("output.weight")
-        if t is not None and t.ggml_type == GGMLType.Q4_K \
+        if t is not None and t.ggml_type in fusable \
                 and q4k_compatible(*reversed(t.shape)):
-            ok.add("output")
+            ok["output"] = t.ggml_type
         return ok
 
-    q4k_names = _q4k_names() if fmt == "q4k" else set()
+    fused_names = _fused_names() if fmt == "q4k" else {}
 
     def lin(name: str) -> dict:
         short = name.split(".")[-2] if name.startswith("blk.") else name.split(".")[0]
-        if short in q4k_names:
+        if short in fused_names:
+            from ..gguf.constants import GGMLType
+            from ..ops.pallas.q6matmul import prep_q6k
             from ..ops.pallas.qmatmul import prep_q4k
 
             t = gf[name]
             n_out, k_in = tuple(reversed(t.shape))
-            return prep_q4k(np.asarray(t.raw()), n_out, k_in)
+            prep = (prep_q4k if fused_names[short] == GGMLType.Q4_K
+                    else prep_q6k)
+            return prep(np.asarray(t.raw()), n_out, k_in)
         if on_device:
             w = _tensor_to_device(gf[name])
             if base_fmt == "int8":

@@ -59,12 +59,28 @@ def make_linear_q4k(w: np.ndarray) -> dict:
     return prep_q4k(quant_q4_k(w.reshape(-1)), n_out, k_in)
 
 
+def make_linear_q6k(w: np.ndarray) -> dict:
+    """(out, in) float weights → fused-kernel Q6_K layout (quantize with the
+    in-tree codec, then pack for ops/pallas/q6matmul.py).  ~7 bit/weight in
+    HBM; the format Q4_K_M files use for ffn_down / attn_v / output."""
+    from ..gguf.quants import quant_q6_k
+    from .pallas.q6matmul import prep_q6k
+
+    w = np.ascontiguousarray(w, dtype=np.float32)
+    n_out, k_in = w.shape
+    return prep_q6k(quant_q6_k(w.reshape(-1)), n_out, k_in)
+
+
 def linear(x: jax.Array, w: dict) -> jax.Array:
     """x: (..., in) bf16 → (..., out) bf16."""
     if "qs" in w:
         from .pallas.qmatmul import q4k_matmul
 
         return q4k_matmul(x, w)
+    if "q4" in w:
+        from .pallas.q6matmul import q6k_matmul
+
+        return q6k_matmul(x, w)
     if "w" in w:
         return jax.lax.dot_general(
             x, w["w"],

@@ -1,0 +1,296 @@
+"""Fused Q6_K dequant-matmul (Pallas): the Q4_K_M file's *other* format.
+
+llama.cpp's Q4_K_M quantization (the reference's served artifact,
+reference api.py:14, docker/Dockerfile.base:30-32) is mixed: most linears
+are Q4_K but ``ffn_down``, some ``attn_v`` layers and ``output.weight`` are
+**Q6_K** (~27% of the weights).  Round 2 served those from an int8 requant
+(1 B/weight); this kernel keeps them at their file precision and
+~0.88 B/weight in HBM — less decode traffic AND less of the 16 GB chip —
+so a Q4_K_M file serves fully fused with no requantization anywhere.
+
+Same design as the v2 Q4_K kernel (ops/pallas/qmatmul.py — float nibble
+split, lane-tiled scales, corrections folded into extra K columns), adapted
+to Q6_K's layout (gguf/quants.py: ``y = d·sc[j]·(q6−32)``, 16 sub-blocks of
+16, int8 sub-scales, ``q6 = ql_nibble | qh_crumb<<4`` ∈ [0,64)):
+
+- the 4 low bits of each weight ride a re-biased packed byte
+  ``v4 = (hi−8)·16 + lo`` (two weights/byte), split by ``floor``;
+- the 2 high bits ride a crumb byte ``v2 = ((c3·4+c2)·4+c1)·4+c0 − 128``
+  (four weights/byte), split by a 3-step ``floor`` chain;
+- a K-tile of 2048 = 8 super-blocks × 16 sub-blocks = exactly **128
+  sub-scales**, so with element-major columns (column ``c`` → sub-block
+  ``c % 128``) the effective scale ``d·sc`` lane-tiles with period 128 —
+  one vreg-tiling ``pltpu.repeat``, no arithmetic;
+- per weight the kernel computes ``nib·eff + crumb·(16·eff)`` (2 muls, 1
+  add, 1 cast); the −32 offset and the hi-half's +8 nibble bias become 256
+  correction columns dotted against per-sub-block activation sums.
+
+Layout contract (:func:`prep_q6k`):
+
+- ``q4`` (N, K/2) int8 — tile-local byte ``b`` ∈ [0,1024) holds the low
+  nibbles of columns ``b`` and ``b+1024``; column ``c = e·128 + s``,
+  sub-block ``s = c % 128`` (block-major), element ``e = c//128`` ∈ [0,16).
+- ``q2`` (N, K/4) int8 — byte ``b`` ∈ [0,512) holds the crumbs of columns
+  ``b``, ``b+512``, ``b+1024``, ``b+1536`` (c0..c3 low-to-high).
+- ``sm6`` (K/2048, N, 128) bf16 — the 128 effective sub-scales ``d·sc`` of
+  the tile, block-major.
+
+Shape requirements: ``K % 2048 == 0``, ``N % 128`` == 0 — same classes as
+the Q4_K kernel; ineligible tensors fall back to int8 (models/params.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
+from .qmatmul import TK, _interpret, _pick_tn, _spec_axis, q4k_compatible
+
+_SUBS6 = TK // 16    # 128 sub-blocks of 16 per k-tile
+TKA6 = TK + 256      # + [xsum_all(128) | xsum_hi(128)] correction columns
+
+
+q6k_compatible = q4k_compatible  # same divisibility classes
+
+
+# ---------------------------------------------------------------------------
+# host-side weight prep
+# ---------------------------------------------------------------------------
+
+def prep_q6k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
+    """Raw Q6_K block bytes (row-major, ``n_out`` rows of ``k_in`` elements)
+    → the kernel layout dict {"q4", "q2", "sm6"}."""
+    if not q6k_compatible(n_out, k_in):
+        raise ValueError(f"({n_out}, {k_in}) not fused-Q6_K compatible "
+                         f"(need K%{TK}==0, N%128==0)")
+    bs = GGML_BLOCK_SIZES[GGMLType.Q6_K][1]           # 210
+    nb = k_in // QK_K
+    kt = k_in // TK
+    blocks = np.ascontiguousarray(raw, dtype=np.uint8)[: n_out * nb * bs]
+    blocks = blocks.reshape(n_out, nb, bs)
+    ql = blocks[..., 0:128].reshape(n_out, nb, 2, 64)
+    qh = blocks[..., 128:192].reshape(n_out, nb, 2, 32)
+    sc = blocks[..., 192:208].view(np.int8).astype(np.float32)  # (N, nb, 16)
+    d = blocks[..., 208:210].copy().view(np.float16).astype(np.float32)[..., 0]
+
+    low = np.empty((n_out, nb, 2, 128), dtype=np.uint8)
+    low[..., 0:64] = ql & 0x0F
+    low[..., 64:128] = ql >> 4
+    hi = np.empty((n_out, nb, 2, 128), dtype=np.uint8)
+    hi[..., 0:32] = qh & 3
+    hi[..., 32:64] = (qh >> 2) & 3
+    hi[..., 64:96] = (qh >> 4) & 3
+    hi[..., 96:128] = (qh >> 6) & 3
+    q6 = (low | (hi << 4)).reshape(n_out, nb, 256)    # elem idx = sub*16 + e
+
+    # element-major tile columns: Q[..., e, s], s = blk*16 + sub
+    Q = q6.reshape(n_out, kt, 8, 16, 16).transpose(0, 1, 4, 2, 3)
+    Q = np.ascontiguousarray(Q).reshape(n_out, kt, 16, _SUBS6)
+    nib = Q & 0x0F
+    crumb = Q >> 4                                    # ∈ [0, 4)
+
+    lo4 = nib[:, :, :8, :].reshape(n_out, kt, TK // 2)
+    hi4 = nib[:, :, 8:, :].reshape(n_out, kt, TK // 2)
+    v4 = ((hi4.astype(np.int16) - 8) << 4) + lo4
+    q4 = v4.astype(np.int8).reshape(n_out, k_in // 2)
+
+    cr = crumb.reshape(n_out, kt, 4, TK // 4).astype(np.int16)
+    v2 = (((cr[:, :, 3] * 4 + cr[:, :, 2]) * 4 + cr[:, :, 1]) * 4
+          + cr[:, :, 0]) - 128
+    q2 = v2.astype(np.int8).reshape(n_out, k_in // 4)
+
+    eff = d[..., None] * sc                           # (N, nb, 16)
+    sm6 = eff.reshape(n_out, kt, _SUBS6).transpose(1, 0, 2)
+    return {
+        "q4": jnp.asarray(q4),
+        "q2": jnp.asarray(q2),
+        "sm6": jnp.asarray(np.ascontiguousarray(sm6), dtype=jnp.bfloat16),
+    }
+
+
+def permute_x6(x: jax.Array) -> jax.Array:
+    """(..., K) → (..., K): element-major column order (column ``e·128+s`` ←
+    original element ``(s//16)·256 + (s%16)·16 + e``)."""
+    K = x.shape[-1]
+    lead = x.shape[:-1]
+    nl = len(lead)
+    xb = x.reshape(*lead, K // TK, 8, 16, 16)         # [blk, sub, e]
+    xe = jnp.transpose(xb, (*range(nl), nl, nl + 3, nl + 1, nl + 2))
+    return xe.reshape(*lead, K)
+
+
+def augment_x6(xp: jax.Array) -> jax.Array:
+    """Permuted activations (B, K) → (B, K/TK·TKA6): each tile gains 256
+    correction columns [sum per sub-block | sum over the hi-nibble half]
+    dotted against [−32·eff | 8·eff]."""
+    B, K = xp.shape
+    kt = K // TK
+    xt = xp.reshape(B, kt, 16, _SUBS6)
+    xsum = jnp.sum(xt, axis=2)                        # (B, kt, 128)
+    xsum_hi = jnp.sum(xt[:, :, 8:, :], axis=2)
+    xpa = jnp.concatenate([xt.reshape(B, kt, TK), xsum, xsum_hi], axis=-1)
+    return xpa.reshape(B, kt * TKA6)
+
+
+def dequant_ref6(w: dict) -> jax.Array:
+    """(N, K) f32 dequantized weights in **permuted** column order."""
+    N, half = w["q4"].shape
+    kt = half // (TK // 2)
+    v4 = w["q4"].astype(jnp.float32).reshape(N, kt, TK // 2)
+    h = jnp.floor(v4 / 16.0)
+    nib = jnp.concatenate([v4 - 16.0 * h, h + 8.0], axis=2)   # (N, kt, TK)
+    u = w["q2"].astype(jnp.float32).reshape(N, kt, TK // 4) + 128.0
+    c3 = jnp.floor(u / 64.0)
+    r = u - 64.0 * c3
+    c2 = jnp.floor(r / 16.0)
+    r = r - 16.0 * c2
+    c1 = jnp.floor(r / 4.0)
+    c0 = r - 4.0 * c1
+    crumb = jnp.concatenate([c0, c1, c2, c3], axis=2)         # (N, kt, TK)
+    q6 = nib + 16.0 * crumb
+    eff = jnp.transpose(w["sm6"], (1, 0, 2)).astype(jnp.float32)
+    eff = jnp.tile(eff, (1, 1, TK // _SUBS6))
+    return (eff * (q6 - 32.0)).reshape(N, kt * TK)
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _q6k_matmul_kernel(xpa_ref, q4_ref, q2_ref, sm_ref, o_ref, *, interpret):
+    TN = q4_ref.shape[0]
+    v4 = q4_ref[...].astype(jnp.float32)              # (TN, TK/2)
+    h = jnp.floor(v4 * 0.0625)
+    l = v4 - h * 16.0
+    nib = jnp.concatenate([l, h], axis=1)             # (TN, TK); hi bias → corr
+
+    u = q2_ref[...].astype(jnp.float32) + 128.0       # (TN, TK/4)
+    c3 = jnp.floor(u * (1.0 / 64.0))
+    r = u - 64.0 * c3
+    c2 = jnp.floor(r * 0.0625)
+    r = r - 16.0 * c2
+    c1 = jnp.floor(r * 0.25)
+    c0 = r - 4.0 * c1
+    crumb = jnp.concatenate([c0, c1, c2, c3], axis=1)  # (TN, TK)
+
+    sm = sm_ref[...].reshape(TN, 128)                 # eff = d·sc
+    if interpret:
+        eff = jnp.tile(sm, (1, TK // 128)).astype(jnp.float32)
+        eff16 = jnp.tile(sm * 16.0, (1, TK // 128)).astype(jnp.float32)
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+
+        eff = pltpu.repeat(sm, TK // 128, axis=1).astype(jnp.float32)
+        eff16 = pltpu.repeat(sm * 16.0, TK // 128, axis=1).astype(jnp.float32)
+
+    a = (nib * eff + crumb * eff16).astype(jnp.bfloat16)
+    corr = jnp.concatenate([sm * -32.0, sm * 8.0], axis=1).astype(jnp.bfloat16)
+
+    xpa = xpa_ref[...]
+    part = jax.lax.dot_general(
+        xpa[:, :TK], a, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    part += jax.lax.dot_general(
+        xpa[:, TK:], corr, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] += part
+
+
+def _q6k_2d_raw(xpa: jax.Array, q4: jax.Array, q2: jax.Array, sm: jax.Array,
+                interpret: bool) -> jax.Array:
+    B, KA = xpa.shape
+    K = (KA // TKA6) * TK
+    N = q4.shape[0]
+    TN = _pick_tn(N, interpret)
+    grid = (N // TN, K // TK)
+    return pl.pallas_call(
+        functools.partial(_q6k_matmul_kernel, interpret=interpret),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B, TKA6), lambda n, k: (0, k)),
+            pl.BlockSpec((TN, TK // 2), lambda n, k: (n, k)),
+            pl.BlockSpec((TN, TK // 4), lambda n, k: (n, k)),
+            pl.BlockSpec((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, TN), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
+        interpret=interpret,
+    )(xpa, q4, q2, sm)
+
+
+@functools.lru_cache(maxsize=4)
+def _q6k_2d_partitioned(interpret: bool):
+    """GSPMD rule mirroring the Q4_K kernel's: partition over N (and rows),
+    never over K; tp-sharded weights compute locally."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @custom_partitioning
+    def fn(xpa, q4, q2, sm):
+        return _q6k_2d_raw(xpa, q4, q2, sm, interpret)
+
+    def partition(mesh, arg_shapes, result_shape):
+        xp_s, q4_s, q2_s, sm_s = (a.sharding for a in arg_shapes)
+        rows = _spec_axis(xp_s, 0)
+        n_ax = _spec_axis(q4_s, 0)
+        arg_shardings = (
+            NamedSharding(mesh, P(rows, None)),
+            NamedSharding(mesh, P(n_ax, None)),
+            NamedSharding(mesh, P(n_ax, None)),
+            NamedSharding(mesh, P(None, n_ax, None)),
+        )
+        result_sharding = NamedSharding(mesh, P(rows, n_ax))
+
+        def lower(xpa, q4, q2, sm):
+            return _q6k_2d_raw(xpa, q4, q2, sm, interpret)
+
+        return mesh, lower, result_sharding, arg_shardings
+
+    def infer(mesh, arg_shapes, result_shape):
+        return NamedSharding(
+            mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
+                    _spec_axis(arg_shapes[1].sharding, 0)))
+
+    fn.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule="b k, n j, n p, t n l -> b n",
+    )
+    return jax.jit(fn)
+
+
+_MAX_B6 = 256
+
+
+def q6k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:
+    """x (..., K) bf16/f32 → (..., N) in x.dtype, weights in Q6_K kernel
+    layout.  The fused path of ``ops.linear.linear`` for Q6_K tensors."""
+    K = x.shape[-1]
+    lead = x.shape[:-1]
+    xpa = augment_x6(permute_x6(x).reshape(-1, K).astype(jnp.bfloat16))
+    itp = _interpret(interpret)
+    fn = _q6k_2d_partitioned(itp)
+    B = xpa.shape[0]
+    if B <= _MAX_B6:
+        y = fn(xpa, w["q4"], w["q2"], w["sm6"])
+    else:
+        pad = (-B) % _MAX_B6
+        if pad:
+            xpa = jnp.concatenate(
+                [xpa, jnp.zeros((pad, xpa.shape[1]), xpa.dtype)], axis=0)
+        chunks = [
+            fn(xpa[i:i + _MAX_B6], w["q4"], w["q2"], w["sm6"])
+            for i in range(0, B + pad, _MAX_B6)
+        ]
+        y = jnp.concatenate(chunks, axis=0)[:B]
+    return y.reshape(*lead, -1).astype(x.dtype)

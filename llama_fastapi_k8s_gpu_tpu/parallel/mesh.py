@@ -65,12 +65,18 @@ def _linear_sharding(mesh: Mesh, col_parallel: bool) -> dict:
                 "q": _ns(mesh, None, "tp", None),
                 "s": _ns(mesh, None, "tp"),
                 "qs": _ns(mesh, None, "tp", None),
-                "sm": _ns(mesh, None, None, "tp", None)}
+                "sm": _ns(mesh, None, None, "tp", None),
+                "q4": _ns(mesh, None, "tp", None),
+                "q2": _ns(mesh, None, "tp", None),
+                "sm6": _ns(mesh, None, None, "tp", None)}
     return {"w": _ns(mesh, None, None, "tp"),
             "q": _ns(mesh, None, None, "tp"),
             "s": _ns(mesh, None, None),
             "qs": _ns(mesh, None, "tp", None),
-            "sm": _ns(mesh, None, None, "tp", None)}
+            "sm": _ns(mesh, None, None, "tp", None),
+            "q4": _ns(mesh, None, "tp", None),
+            "q2": _ns(mesh, None, "tp", None),
+            "sm6": _ns(mesh, None, None, "tp", None)}
 
 
 def _match_linear(shardings: dict, linear: dict) -> dict:
@@ -93,7 +99,9 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
     out = params["output"]
     head = {"w": _ns(mesh, "tp", None), "q": _ns(mesh, "tp", None),
             "s": _ns(mesh, "tp"), "qs": _ns(mesh, "tp", None),
-            "sm": _ns(mesh, None, "tp", None)}
+            "sm": _ns(mesh, None, "tp", None),
+            "q4": _ns(mesh, "tp", None), "q2": _ns(mesh, "tp", None),
+            "sm6": _ns(mesh, None, "tp", None)}
     out_shard = {k: head[k] for k in out}
     return {
         "tok_emb": _ns(mesh, None, None),      # replicated (gather-heavy)
@@ -147,17 +155,29 @@ def _fit_sharding(arr, ns: NamedSharding) -> NamedSharding:
     return NamedSharding(mesh, P(*fixed))
 
 
+_FUSED_MAIN_KEY = {"qs": "qs", "q4": "q4"}   # fused layout → its (…,N,K/x) leaf
+
+
+def _fused_key(p: dict) -> str | None:
+    for k in _FUSED_MAIN_KEY:
+        if k in p:
+            return k
+    return None
+
+
 def _fit_q4k(leaf: dict, shard: dict) -> dict:
-    """Fused-Q4_K leaves: keep the N sharding only if every local shard
+    """Fused Q4_K/Q6_K leaves: keep the N sharding only if every local shard
     still satisfies the kernel's N tiling (128 sublanes on TPU, 8 in
     interpret mode); otherwise replicate the whole leaf — a half-sharded
-    {qs, sm} pair would just reshard inside the partition rule."""
+    {qs, sm} / {q4, q2, sm6} group would just reshard inside the
+    partition rule."""
     from ..ops.pallas import use_interpret
 
     gran = 8 if use_interpret() else 128
-    qs = leaf["qs"]
-    ns = shard["qs"]
-    n_dim = qs.ndim - 2                      # (L, N, K/2) or (N, K/2)
+    key = _fused_key(leaf)
+    qs = leaf[key]
+    ns = shard[key]
+    n_dim = qs.ndim - 2                      # (L, N, K/x) or (N, K/x)
     spec = list(ns.spec) + [None] * (qs.ndim - len(ns.spec))
     axes = spec[n_dim]
     keep = True
@@ -174,12 +194,13 @@ def _fit_q4k(leaf: dict, shard: dict) -> dict:
 
 def fit_shardings(params: dict, shardings: dict) -> dict:
     def fit(p, s):
-        if isinstance(p, dict) and "qs" in p:
+        if isinstance(p, dict) and _fused_key(p):
             return _fit_q4k(p, s)
         return jax.tree.map(_fit_sharding, p, s)
 
-    return jax.tree.map(fit, params, shardings,
-                        is_leaf=lambda x: isinstance(x, dict) and "qs" in x)
+    return jax.tree.map(
+        fit, params, shardings,
+        is_leaf=lambda x: isinstance(x, dict) and _fused_key(x) is not None)
 
 
 def shard_params(params: dict, mesh: Mesh) -> dict:

@@ -1,0 +1,171 @@
+"""Fused Q6_K dequant-matmul kernel vs the dequant-then-matmul oracle.
+
+Same contract as tests/test_qmatmul.py: the kernel must agree with an XLA
+matmul against ``dequant_ref6`` (bf16-folded scales) and, end to end, with
+the numpy Q6_K codec within quantization-noise tolerance.  Q6_K is what
+Q4_K_M files use for ffn_down / attn_v / output (the reference's served
+artifact mixes both types), so this is the second half of "serve Q4_K_M
+fully fused"."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from llama_fastapi_k8s_gpu_tpu.gguf.quants import dequant_q6_k, quant_q6_k
+from llama_fastapi_k8s_gpu_tpu.ops.linear import linear, make_linear_q6k
+from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import (
+    dequant_ref6,
+    permute_x6,
+    prep_q6k,
+    q6k_matmul,
+)
+
+
+def _rand_weights(rng, n, k):
+    return (rng.standard_normal((n, k)).astype(np.float32) * (k ** -0.5))
+
+
+@pytest.mark.parametrize("n,k,b", [
+    (8, 2048, 1),       # minimum interpret-mode N tile, decode matvec
+    (128, 2048, 4),     # TPU-shaped single k-tile
+    (256, 4096, 2),     # full-size tiles, 2 k-steps
+    (24, 6144, 3),      # non-power-of-two N (TN=8), 3 k-tiles
+])
+def test_kernel_matches_dequant_ref6(n, k, b):
+    rng = np.random.default_rng(n + k)
+    w = make_linear_q6k(_rand_weights(rng, n, k))
+    x = jnp.asarray(rng.standard_normal((b, k)), jnp.float32)
+
+    ref = permute_x6(x).astype(jnp.bfloat16).astype(jnp.float32) @ dequant_ref6(w).T
+    got = q6k_matmul(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2 * float(jnp.abs(ref).max()))
+
+
+def test_end_to_end_vs_numpy_codec():
+    rng = np.random.default_rng(0)
+    n, k = 64, 2048
+    wf = _rand_weights(rng, n, k)
+    raw = quant_q6_k(wf.reshape(-1))
+    w = prep_q6k(raw, n, k)
+    w_deq = dequant_q6_k(raw, n * k).reshape(n, k)
+
+    x = rng.standard_normal((2, k)).astype(np.float32)
+    ref = x @ w_deq.T
+    got = np.asarray(q6k_matmul(jnp.asarray(x), w))
+    np.testing.assert_allclose(got, ref, rtol=3e-2,
+                               atol=3e-2 * float(np.abs(ref).max()))
+
+
+def test_prep_roundtrips_exact_values():
+    """prep_q6k's repack must preserve every 6-bit value and scale exactly:
+    dequant_ref6 (over the packed layout) == numpy codec dequant up to the
+    bf16 scale fold, in the permuted column order."""
+    rng = np.random.default_rng(1)
+    n, k = 16, 2048
+    raw = quant_q6_k(_rand_weights(rng, n, k).reshape(-1))
+    w = prep_q6k(raw, n, k)
+    ref = dequant_q6_k(raw, n * k).reshape(n, k)
+    ref_p = np.asarray(permute_x6(jnp.asarray(ref)))
+    got = np.asarray(dequant_ref6(w))
+    np.testing.assert_allclose(got, ref_p, rtol=8e-3,
+                               atol=8e-3 * float(np.abs(ref).max()))
+
+
+def test_linear_dispatch_routes_q6k():
+    rng = np.random.default_rng(2)
+    w = make_linear_q6k(_rand_weights(rng, 16, 2048))
+    x = jnp.asarray(rng.standard_normal((3, 2048)), jnp.bfloat16)
+    y = linear(x, w)
+    assert y.shape == (3, 16) and y.dtype == jnp.bfloat16
+
+
+def test_permute_x6_is_a_permutation():
+    x = jnp.arange(2048, dtype=jnp.float32)
+    p = np.asarray(permute_x6(x))
+    assert sorted(p.tolist()) == list(range(2048))
+    # column c = e*128 + s holds original element (s//16)*256 + (s%16)*16 + e
+    for c in (0, 1, 15, 16, 17, 127, 128, 129, 2047):
+        s, e = c % 128, c // 128
+        assert p[c] == (s // 16) * 256 + (s % 16) * 16 + e, c
+
+
+def test_under_jit_and_scan():
+    rng = np.random.default_rng(3)
+    L, n, kdim = 3, 16, 2048
+    ws = [make_linear_q6k(_rand_weights(rng, n, kdim)) for _ in range(L)]
+    stacked = {key: jnp.stack([w[key] for w in ws]) for key in ws[0]}
+    x = jnp.asarray(rng.standard_normal((1, kdim)), jnp.bfloat16)
+
+    @jax.jit
+    def f(stacked, x):
+        def step(carry, wl):
+            return carry, linear(carry, wl)
+
+        _, ys = jax.lax.scan(step, x, stacked)
+        return ys
+
+    ys = f(stacked, x)
+    assert ys.shape == (L, 1, n)
+    np.testing.assert_allclose(np.asarray(ys[0]), np.asarray(linear(x, ws[0])),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_load_params_q4km_fuses_both_types(tmp_path):
+    """A Q4_K_M-style file (attn Q4_K, ffn Q6_K): Q4_K names load the fused
+    Q4_K layout, Q6_K names load the fused **Q6_K** layout (round 2 sent
+    them to int8), and forward logits agree with a bf16 load."""
+    from llama_fastapi_k8s_gpu_tpu.gguf import GGMLType, GGUFFile
+    from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+    from llama_fastapi_k8s_gpu_tpu.models.llama import init_cache, prefill
+    from llama_fastapi_k8s_gpu_tpu.models.params import load_params
+    from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+
+    cfg = ModelConfig(vocab_size=263, dim=2048, n_layers=1, n_heads=16,
+                      n_kv_heads=8, ffn_dim=2048, n_ctx=32)
+    path = str(tmp_path / "q4km.gguf")
+    cfg = write_tiny_llama_gguf(path, cfg=cfg, quant=GGMLType.Q4_K,
+                                ffn_quant=GGMLType.Q6_K)
+    gf = GGUFFile(path)
+    params = load_params(gf, cfg, fmt="q4k", on_device=False)
+    assert "qs" in params["layers"]["wq"]
+    assert "q4" in params["layers"]["w_gate"]          # fused Q6_K now
+
+    ref = load_params(gf, cfg, fmt="bf16", on_device=False)
+    toks = jnp.arange(1, 9, dtype=jnp.int32)
+    lg_q, _ = prefill(params, cfg, toks, jnp.int32(8), init_cache(cfg))
+    lg_r, _ = prefill(ref, cfg, toks, jnp.int32(8), init_cache(cfg))
+    a, b = np.asarray(lg_q), np.asarray(lg_r)
+    denom = np.abs(b).max() + 1e-6
+    assert np.abs(a - b).max() / denom < 0.08, np.abs(a - b).max() / denom
+
+
+def test_q6k_params_shard_over_mesh():
+    """param_shardings must cover {'q4','q2','sm6'} dicts."""
+    import numpy as np
+
+    from llama_fastapi_k8s_gpu_tpu.parallel.mesh import make_mesh, shard_params
+
+    rng = np.random.default_rng(4)
+    w = make_linear_q6k(_rand_weights(rng, 256, 2048))
+    params = {
+        "tok_emb": jnp.zeros((64, 32), jnp.bfloat16),
+        "layers": {"attn_norm": jnp.ones((1, 32)),
+                   "wq": {k: v[None] for k, v in w.items()},
+                   "wk": {k: v[None] for k, v in w.items()},
+                   "wv": {k: v[None] for k, v in w.items()},
+                   "wo": {k: v[None] for k, v in w.items()},
+                   "ffn_norm": jnp.ones((1, 32)),
+                   "w_gate": {k: v[None] for k, v in w.items()},
+                   "w_up": {k: v[None] for k, v in w.items()},
+                   "w_down": {k: v[None] for k, v in w.items()}},
+        "out_norm": jnp.ones(32),
+        "output": {"w": jnp.zeros((64, 32), jnp.bfloat16)},
+    }
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    sharded = shard_params(params, mesh)
+    assert sharded["layers"]["wq"]["q4"].shape == params["layers"]["wq"]["q4"].shape

@@ -110,9 +110,10 @@ def test_under_jit_and_scan():
 # ---------------------------------------------------------------------------
 
 def test_load_params_q4k_mixed_formats(tmp_path):
-    """A Q4_K_M-style file (attn Q4_K, ffn Q6_K): eligible names load in the
-    fused layout straight from raw bytes, the rest fall back to int8, and
-    the forward logits agree with a bf16 load within quantization noise."""
+    """A Q4_K_M-style file (attn Q4_K, ffn Q6_K): Q4_K names load in the
+    fused Q4_K layout straight from raw bytes, Q6_K names in the fused Q6_K
+    layout (tests/test_q6matmul.py covers that kernel), and the forward
+    logits agree with a bf16 load within quantization noise."""
     from llama_fastapi_k8s_gpu_tpu.gguf import GGMLType, GGUFFile
     from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
     from llama_fastapi_k8s_gpu_tpu.models.llama import init_cache, prefill
@@ -126,9 +127,9 @@ def test_load_params_q4k_mixed_formats(tmp_path):
                                 ffn_quant=GGMLType.Q6_K)
     gf = GGUFFile(path)
     params = load_params(gf, cfg, fmt="q4k", on_device=False)
-    # attn linears fused, ffn fell back to int8
+    # attn linears fused Q4_K, ffn fused Q6_K
     assert "qs" in params["layers"]["wq"] and "sm" in params["layers"]["wq"]
-    assert "q" in params["layers"]["w_gate"]
+    assert "q4" in params["layers"]["w_gate"]
 
     ref = load_params(gf, cfg, fmt="bf16", on_device=False)
     toks = jnp.arange(1, 9, dtype=jnp.int32)

@@ -273,12 +273,21 @@ def test_big_vocab_10kb_under_50ms(big_bpe):
 
     # worst-ish case: one unbroken 10 KiB letter fragment (no pre-split),
     # deep cascading merges.  The round-2 quadratic loop takes seconds here.
+    def best_of(text, n=3):
+        """best-of-n: immune to CI scheduling noise, still pins the
+        algorithmic bound (the quadratic loop took seconds here)"""
+        best = float("inf")
+        ids = None
+        for _ in range(n):
+            t0 = time.perf_counter()
+            ids = big_bpe.encode(text, add_bos=False)
+            best = min(best, time.perf_counter() - t0)
+        assert ids
+        return best
+
     text = "ab" * 5120  # 10 KiB, single \p{L}+ fragment
     big_bpe.encode(text, add_bos=False)  # warm caches
-    t0 = time.perf_counter()
-    ids = big_bpe.encode(text, add_bos=False)
-    dt = time.perf_counter() - t0
-    assert ids
+    dt = best_of(text)
     assert dt < 0.050, f"10KB encode took {dt*1e3:.1f} ms"
 
     # and a mixed, space-separated 10 KiB text
@@ -286,10 +295,7 @@ def test_big_vocab_10kb_under_50ms(big_bpe):
     words = ["".join("abcdefgh"[int(c)] for c in rng.integers(0, 8, int(w)))
              for w in rng.integers(2, 12, 2000)]
     text2 = " ".join(words)[:10240]
-    t0 = time.perf_counter()
-    ids2 = big_bpe.encode(text2, add_bos=False)
-    dt2 = time.perf_counter() - t0
-    assert ids2
+    dt2 = best_of(text2)
     assert dt2 < 0.050, f"10KB mixed encode took {dt2*1e3:.1f} ms"
 
 
