@@ -326,6 +326,30 @@ def child_main() -> None:
     # tunnel hangs or faults here when another process holds the device)
     print(f"{_INIT_MARK} {dev}", file=sys.stderr, flush=True)
 
+    # compile-probe the risky Pallas kernels up front (ops/pallas/probe.py)
+    # so a Mosaic failure degrades the config — with correct attribution in
+    # the result JSON — instead of zeroing the whole headline
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import (
+        probe_flash_attention,
+        probe_fused_q4k,
+    )
+
+    fallbacks = {}
+    if wfmt == "q4k":
+        err = probe_fused_q4k()
+        if err is not None:
+            fallbacks["fmt_fallback"] = f"fused Q4_K kernel: {err}"[:300]
+            print(f"bench: {fallbacks['fmt_fallback']}; using int8",
+                  file=sys.stderr, flush=True)
+            wfmt = "int8"
+    if cfg.attn_impl == "pallas":
+        err = probe_flash_attention()
+        if err is not None:
+            fallbacks["attn_fallback"] = f"flash attention: {err}"[:300]
+            print(f"bench: {fallbacks['attn_fallback']}; using attn_impl=xla",
+                  file=sys.stderr, flush=True)
+            cfg = dataclasses.replace(cfg, attn_impl="xla")
+
     t0 = time.time()
     params = synth_params_device(cfg, fmt=wfmt)
     # label honesty: report q4k only if any tensor actually got the layout
@@ -401,6 +425,7 @@ def child_main() -> None:
         "load_s": round(load_s, 1),
         "compile_s": round(compile_s, 1),
     }
+    result.update(fallbacks)
     print(json.dumps(result), flush=True)
 
 

@@ -70,6 +70,14 @@ def main() -> None:
         n_merges = 280_000
 
     dev = jax.devices()[0]
+    if wfmt == "q4k":
+        from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import probe_fused_q4k
+
+        err = probe_fused_q4k()
+        if err is not None:
+            print(f"bench_server: fused Q4_K probe failed ({err}); int8",
+                  file=sys.stderr, flush=True)
+            wfmt = "int8"
     tokens, merges, types = synth_bpe_vocab(n_merges=n_merges)
     cfg = dataclasses.replace(cfg, vocab_size=len(tokens))
     tok = BPETokenizer(tokens, merges, types,
@@ -161,19 +169,32 @@ def main() -> None:
     lock = threading.Lock()
 
     def worker():
-        for _ in range(per):
+        # closed loop: each thread completes `per` requests, retrying 503s
+        # with a short backoff (clients do the same), so the phase sustains
+        # the advertised concurrency instead of collapsing to queue+1 after
+        # an initial burst of rejections; every 503 is still counted
+        done = 0
+        attempts = 0
+        while done < per and attempts < per * 200:
+            attempts += 1
             t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(post("/response"), timeout=600) as r:
                     r.read()
                 with lock:
                     oks.append((time.perf_counter() - t0) * 1e3)
+                done += 1
             except urllib.error.HTTPError as e:
                 with lock:
                     (rejects if e.code == 503 else errors).append(e.code)
+                if e.code == 503:
+                    time.sleep(0.05)
+                else:
+                    done += 1   # non-503 failure: don't retry forever
             except Exception as e:  # noqa: BLE001 — connection-level failure:
                 with lock:          # count it, keep the sample sizes honest
                     errors.append(type(e).__name__)
+                done += 1
 
     t_conc = time.perf_counter()
     ths = [threading.Thread(target=worker) for _ in range(conc)]

@@ -104,18 +104,29 @@ class Engine:
             self.cfg = ModelConfig.from_gguf(gf, n_ctx=n_ctx)
             self.tokenizer = tokenizer_from_gguf(gf)
             if weight_format == "auto":
-                # bf16 params ≈ 2 bytes/weight; pick int8 when a bf16 copy
-                # would crowd a 16 GB v5e HBM (≳ 4 GB of linear weights).
-                # "q4k" (fused Pallas kernel, ~5 bit/weight) is opt-in via
-                # LFKT_WEIGHT_FORMAT until it beats int8 on-chip — measured
-                # 2026-07: the kernel is currently dequant-bound, not
-                # bandwidth-bound, and loses to int8 on decode.
+                # bf16 params ≈ 2 bytes/weight; small models keep exact
+                # bf16.  Large models on TPU serve "q4k": Q4_K/Q6_K tensors
+                # stay fused (~5 / ~7 bit/weight; the v2 kernels beat the
+                # int8 path at every 8B shape at ~0.55x the HBM bytes —
+                # docs/bench/qmatmul_v2_microbench_2026-07-29.json), and
+                # anything else falls back to int8 per tensor.  On CPU
+                # (tests) the interpret-mode kernels are slow, so big
+                # models requantize to int8 instead.
                 n_lin = self.cfg.n_layers * (
                     4 * self.cfg.dim * self.cfg.dim
                     + 3 * self.cfg.dim * self.cfg.ffn_dim
                 )
-                weight_format = "int8" if n_lin * 2 > 4e9 else "bf16"
-            self.params = load_params(gf, self.cfg, weight_format)
+                if n_lin * 2 <= 4e9:
+                    weight_format = "bf16"
+                elif jax.default_backend() == "tpu":
+                    weight_format = "q4k"
+                else:
+                    weight_format = "int8"
+            fused_types = None
+            if weight_format == "q4k":
+                weight_format, fused_types = self._probe_fused_format()
+            self.params = load_params(gf, self.cfg, weight_format,
+                                      fused_types=fused_types)
             self.template_kind = detect_chat_template(
                 gf.metadata.get("tokenizer.chat_template"), self.tokenizer
             )
@@ -134,6 +145,17 @@ class Engine:
             )
         if attn_impl not in ("xla", "pallas"):
             raise ValueError(f"attn_impl must be auto|xla|pallas, got {attn_impl!r}")
+        if attn_impl == "pallas":
+            # compile-probe the flash kernel NOW (ops/pallas/probe.py): a
+            # Mosaic lowering failure degrades to the XLA path with correct
+            # attribution instead of crash-looping the pod at warmup
+            from ..ops.pallas.probe import probe_flash_attention
+
+            err = probe_flash_attention()
+            if err is not None:
+                logger.error("pallas flash attention failed its compile "
+                             "probe; serving with attn_impl=xla: %s", err)
+                attn_impl = "xla"
         if attn_impl != self.cfg.attn_impl:
             self.cfg = dataclasses.replace(self.cfg, attn_impl=attn_impl)
         self.prefill_buckets = sorted(b for b in prefill_buckets if b <= self.cfg.n_ctx)
@@ -157,6 +179,31 @@ class Engine:
                               tokenizer, **kw)
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _probe_fused_format() -> tuple:
+        """Compile-probe the fused Q4_K/Q6_K kernels (ops/pallas/probe.py);
+        returns ("q4k", {types whose probe passed}) — a Mosaic failure in
+        ONE kernel degrades only that format's tensors to int8, and both
+        failing degrades the whole load — instead of crash-looping the pod
+        (SURVEY.md §5 "Failure detection"; the reference has no analogue
+        because llama.cpp ships precompiled kernels)."""
+        from ..gguf.constants import GGMLType
+        from ..ops.pallas.probe import probe_fused_q4k, probe_fused_q6k
+
+        passed = set()
+        for name, gtype, probe in (
+                ("Q4_K", GGMLType.Q4_K, probe_fused_q4k),
+                ("Q6_K", GGMLType.Q6_K, probe_fused_q6k)):
+            err = probe()
+            if err is None:
+                passed.add(gtype)
+            else:
+                logger.error("fused %s kernel failed its compile probe; "
+                             "its tensors load as int8 instead: %s", name, err)
+        if not passed:
+            return "int8", None
+        return "q4k", frozenset(passed)
+
     def warmup(self):
         """Compile every (bucket, chunk) shape so no request pays a cold
         compile — the TPU analogue of the reference's eager model load."""

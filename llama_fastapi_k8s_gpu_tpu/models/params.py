@@ -64,12 +64,18 @@ def _stack(dicts: list[dict]) -> dict:
 
 
 def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
-                on_device: bool | None = None) -> dict:
+                on_device: bool | None = None,
+                fused_types: frozenset | None = None) -> dict:
     """Dequantize all tensors from ``gf`` into a stacked param pytree.
 
     ``on_device=True`` (default on TPU) routes quantized tensors through the
     Pallas dequant kernels and requantizes int8 on device; ``False`` uses
     the numpy reference codecs.  Both produce identical pytrees.
+
+    ``fused_types`` restricts which GGML types may use their fused kernel
+    under ``fmt="q4k"`` (default: Q4_K and Q6_K).  The engine passes the
+    set of types whose compile probes passed, so a Mosaic regression in
+    ONE kernel degrades only that format's tensors to int8.
     """
     if on_device is None:
         on_device = jax.default_backend() == "tpu"
@@ -84,7 +90,8 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
         from ..gguf.constants import GGMLType
         from ..ops.pallas.qmatmul import q4k_compatible
 
-        fusable = (GGMLType.Q4_K, GGMLType.Q6_K)
+        fusable = tuple(fused_types) if fused_types is not None \
+            else (GGMLType.Q4_K, GGMLType.Q6_K)
         names = ["attn_q", "attn_k", "attn_v", "attn_output",
                  "ffn_gate", "ffn_up", "ffn_down"]
         ok: dict[str, object] = {}

@@ -1,0 +1,102 @@
+"""Startup compile probes for the Pallas kernels.
+
+A Mosaic lowering failure (new libtpu, unexpected geometry) must degrade a
+pod to a slower path — not crash-loop it behind a misleading traceback.
+These probes compile each risky kernel once on a tiny shape at engine
+construction time, so the *caller* can pick the fallback (int8 weights /
+XLA attention) with correct attribution, for every engine variant (serial,
+mesh-batched, continuous, sequence-parallel — they all construct through
+``Engine.__init__``) and for the benches.
+
+Each probe returns ``None`` on success or a short error string; results are
+cached per process (the real warmup then reuses the compiled programs'
+cache lineage at different shapes, so the probe cost is one small Mosaic
+compile each, TPU only — interpret mode always passes cheaply)."""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["probe_fused_q4k", "probe_fused_q6k", "probe_flash_attention"]
+
+
+def _err(e: BaseException) -> str:
+    return f"{type(e).__name__}: {e}"[:400]
+
+
+def _probe_n() -> int:
+    """N for the matmul probes: 512 on TPU so the kernel compiles with the
+    TN=512 tile every 8B serving shape uses (qmatmul._pick_tn); 8 in
+    interpret mode to keep CPU tests fast.  A probe at a toy tile size
+    would miss tile-dependent Mosaic regressions."""
+    from . import use_interpret
+
+    return 8 if use_interpret() else 512
+
+
+@functools.lru_cache(maxsize=1)
+def probe_fused_q4k() -> str | None:
+    """Compile + run the fused Q4_K matmul at the serving tile geometry."""
+    try:
+        import jax.numpy as jnp
+
+        from .qmatmul import prep_q4k, q4k_matmul
+
+        rng = np.random.default_rng(0)
+        from ...gguf.quants import quant_q4_k
+
+        n = _probe_n()
+        w = prep_q4k(quant_q4_k(
+            rng.standard_normal(n * 2048).astype(np.float32) * 0.02),
+            n, 2048)
+        y = q4k_matmul(jnp.ones((1, 2048), jnp.bfloat16), w)
+        float(y.sum())   # host fetch: the only reliable sync on the tunnel
+        return None
+    except Exception as e:  # noqa: BLE001 — any failure means "don't use it"
+        return _err(e)
+
+
+@functools.lru_cache(maxsize=1)
+def probe_fused_q6k() -> str | None:
+    """Compile + run the fused Q6_K matmul at the serving tile geometry."""
+    try:
+        import jax.numpy as jnp
+
+        from ...gguf.quants import quant_q6_k
+        from .q6matmul import prep_q6k, q6k_matmul
+
+        rng = np.random.default_rng(0)
+        n = _probe_n()
+        w = prep_q6k(quant_q6_k(
+            rng.standard_normal(n * 2048).astype(np.float32) * 0.02),
+            n, 2048)
+        y = q6k_matmul(jnp.ones((1, 2048), jnp.bfloat16), w)
+        float(y.sum())
+        return None
+    except Exception as e:  # noqa: BLE001
+        return _err(e)
+
+
+@functools.lru_cache(maxsize=1)
+def probe_flash_attention() -> str | None:
+    """Compile + run the flash prefill kernel at the Llama-3-8B head
+    layout (32 q heads / 8 kv heads / head_dim 128) on a short sequence."""
+    try:
+        import jax.numpy as jnp
+
+        from . import use_interpret
+        from .attention import flash_attention
+
+        itp = use_interpret()
+        S, H, KV, HD, CTX = (8, 2, 2, 128, 32) if itp else (128, 32, 8, 128, 256)
+        q = jnp.ones((S, H, HD), jnp.bfloat16)
+        k = jnp.ones((CTX, KV, HD), jnp.bfloat16)
+        v = jnp.ones((CTX, KV, HD), jnp.bfloat16)
+        y = flash_attention(q, k, v, jnp.int32(0), sm_scale=HD ** -0.5,
+                            interpret=itp)
+        float(y.astype(jnp.float32).sum())
+        return None
+    except Exception as e:  # noqa: BLE001
+        return _err(e)

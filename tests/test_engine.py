@@ -117,3 +117,18 @@ def test_mistral_gguf_end_to_end(tmp_path):
     out = eng.create_chat_completion(MSGS, max_tokens=4, seed=0)
     assert out["object"] == "chat.completion"
     assert out["usage"]["completion_tokens"] >= 1
+
+
+def test_pallas_compile_probes_pass_on_this_backend():
+    """The construction-time kernel probes (ops/pallas/probe.py) must pass
+    wherever the test suite runs (interpret mode on CPU); on TPU they gate
+    the q4k/pallas serving defaults in Engine.__init__."""
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import (
+        probe_flash_attention,
+        probe_fused_q4k,
+        probe_fused_q6k,
+    )
+
+    assert probe_fused_q4k() is None
+    assert probe_fused_q6k() is None
+    assert probe_flash_attention() is None
