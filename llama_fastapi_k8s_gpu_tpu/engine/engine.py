@@ -124,7 +124,8 @@ class Engine:
                     weight_format = "int8"
             fused_types = None
             if weight_format == "q4k":
-                weight_format, fused_types = self._probe_fused_format()
+                present = {t.ggml_type for t in gf.tensors.values()}
+                weight_format, fused_types = self._probe_fused_format(present)
             self.params = load_params(gf, self.cfg, weight_format,
                                       fused_types=fused_types)
             self.template_kind = detect_chat_template(
@@ -180,27 +181,38 @@ class Engine:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _probe_fused_format() -> tuple:
-        """Compile-probe the fused Q4_K/Q6_K kernels (ops/pallas/probe.py);
-        returns ("q4k", {types whose probe passed}) — a Mosaic failure in
-        ONE kernel degrades only that format's tensors to int8, and both
-        failing degrades the whole load — instead of crash-looping the pod
+    def _probe_fused_format(present_types: set | None = None) -> tuple:
+        """Compile-probe the fused Q4_K/Q5_K/Q6_K kernels — only those whose
+        GGML type actually appears in ``present_types`` (the loaded file's
+        tensors), so a Q4_K_M pod never pays a Q5_K probe compile.  Returns
+        ("q4k", {types whose probe passed}): a Mosaic failure in ONE kernel
+        degrades only that format's tensors to int8, and all failing
+        degrades the whole load — instead of crash-looping the pod
         (SURVEY.md §5 "Failure detection"; the reference has no analogue
         because llama.cpp ships precompiled kernels)."""
         from ..gguf.constants import GGMLType
-        from ..ops.pallas.probe import probe_fused_q4k, probe_fused_q6k
+        from ..ops.pallas.probe import (
+            probe_fused_q4k,
+            probe_fused_q5k,
+            probe_fused_q6k,
+        )
 
         passed = set()
+        probed = set()
         for name, gtype, probe in (
                 ("Q4_K", GGMLType.Q4_K, probe_fused_q4k),
+                ("Q5_K", GGMLType.Q5_K, probe_fused_q5k),
                 ("Q6_K", GGMLType.Q6_K, probe_fused_q6k)):
+            if present_types is not None and gtype not in present_types:
+                continue
+            probed.add(gtype)
             err = probe()
             if err is None:
                 passed.add(gtype)
             else:
                 logger.error("fused %s kernel failed its compile probe; "
                              "its tensors load as int8 instead: %s", name, err)
-        if not passed:
+        if not passed and probed:
             return "int8", None
         return "q4k", frozenset(passed)
 

@@ -73,9 +73,9 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     the numpy reference codecs.  Both produce identical pytrees.
 
     ``fused_types`` restricts which GGML types may use their fused kernel
-    under ``fmt="q4k"`` (default: Q4_K and Q6_K).  The engine passes the
-    set of types whose compile probes passed, so a Mosaic regression in
-    ONE kernel degrades only that format's tensors to int8.
+    under ``fmt="q4k"`` (default: Q4_K, Q5_K and Q6_K).  The engine passes
+    the set of types whose compile probes passed, so a Mosaic regression
+    in ONE kernel degrades only that format's tensors to int8.
     """
     if on_device is None:
         on_device = jax.default_backend() == "tpu"
@@ -84,14 +84,14 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
 
     def _fused_names() -> dict[str, object]:
         """Linear positions where ALL layers share one fused-kernel-eligible
-        quantized type (Q4_K or Q6_K — Q4_K_M files mix both; a name whose
-        layers mix types falls back to int8 because stacked scan params need
-        one layout per name)."""
+        quantized type (Q4_K, Q5_K or Q6_K — Q4_K_M/Q5_K_M files mix them;
+        a name whose layers mix types falls back to int8 because stacked
+        scan params need one layout per name)."""
         from ..gguf.constants import GGMLType
         from ..ops.pallas.qmatmul import q4k_compatible
 
         fusable = tuple(fused_types) if fused_types is not None \
-            else (GGMLType.Q4_K, GGMLType.Q6_K)
+            else (GGMLType.Q4_K, GGMLType.Q5_K, GGMLType.Q6_K)
         names = ["attn_q", "attn_k", "attn_v", "attn_output",
                  "ffn_gate", "ffn_up", "ffn_down"]
         ok: dict[str, object] = {}
@@ -114,13 +114,14 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
         short = name.split(".")[-2] if name.startswith("blk.") else name.split(".")[0]
         if short in fused_names:
             from ..gguf.constants import GGMLType
+            from ..ops.pallas.q5matmul import prep_q5k
             from ..ops.pallas.q6matmul import prep_q6k
             from ..ops.pallas.qmatmul import prep_q4k
 
             t = gf[name]
             n_out, k_in = tuple(reversed(t.shape))
-            prep = (prep_q4k if fused_names[short] == GGMLType.Q4_K
-                    else prep_q6k)
+            prep = {GGMLType.Q4_K: prep_q4k, GGMLType.Q5_K: prep_q5k,
+                    GGMLType.Q6_K: prep_q6k}[fused_names[short]]
             return prep(np.asarray(t.raw()), n_out, k_in)
         if on_device:
             w = _tensor_to_device(gf[name])

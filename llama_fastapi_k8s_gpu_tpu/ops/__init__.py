@@ -4,5 +4,6 @@ from .linear import (  # noqa: F401
     make_linear_int8,
     make_linear_int8_device,
     make_linear_q4k,
+    make_linear_q5k,
     make_linear_q6k,
 )

@@ -71,6 +71,17 @@ def make_linear_q6k(w: np.ndarray) -> dict:
     return prep_q6k(quant_q6_k(w.reshape(-1)), n_out, k_in)
 
 
+def make_linear_q5k(w: np.ndarray) -> dict:
+    """(out, in) float weights → fused-kernel Q5_K layout (quantize with the
+    in-tree codec, then pack for ops/pallas/q5matmul.py).  ~6 bit/weight."""
+    from ..gguf.quants import quant_q5_k
+    from .pallas.q5matmul import prep_q5k
+
+    w = np.ascontiguousarray(w, dtype=np.float32)
+    n_out, k_in = w.shape
+    return prep_q5k(quant_q5_k(w.reshape(-1)), n_out, k_in)
+
+
 def linear(x: jax.Array, w: dict) -> jax.Array:
     """x: (..., in) bf16 → (..., out) bf16."""
     if "qs" in w:
@@ -81,6 +92,10 @@ def linear(x: jax.Array, w: dict) -> jax.Array:
         from .pallas.q6matmul import q6k_matmul
 
         return q6k_matmul(x, w)
+    if "q5s" in w:
+        from .pallas.q5matmul import q5k_matmul
+
+        return q5k_matmul(x, w)
     if "w" in w:
         return jax.lax.dot_general(
             x, w["w"],

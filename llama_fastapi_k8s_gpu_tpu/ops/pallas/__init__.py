@@ -43,6 +43,7 @@ from .dequant import (  # noqa: E402
     dequant_q6_k_device,
     dequant_q8_0_device,
 )
+from .q5matmul import prep_q5k, q5k_matmul  # noqa: E402
 from .q6matmul import prep_q6k, q6k_matmul  # noqa: E402
 from .qmatmul import prep_q4k, q4k_matmul  # noqa: E402
 
@@ -54,8 +55,10 @@ __all__ = [
     "dequant_q6_k_device",
     "dequant_q8_0_device",
     "prep_q4k",
+    "prep_q5k",
     "prep_q6k",
     "q4k_matmul",
+    "q5k_matmul",
     "q6k_matmul",
     "force_interpret",
     "use_interpret",

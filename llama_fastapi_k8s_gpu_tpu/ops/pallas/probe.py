@@ -19,7 +19,8 @@ import functools
 
 import numpy as np
 
-__all__ = ["probe_fused_q4k", "probe_fused_q6k", "probe_flash_attention"]
+__all__ = ["probe_fused_q4k", "probe_fused_q5k", "probe_fused_q6k",
+           "probe_flash_attention"]
 
 
 def _err(e: BaseException) -> str:
@@ -55,6 +56,27 @@ def probe_fused_q4k() -> str | None:
         float(y.sum())   # host fetch: the only reliable sync on the tunnel
         return None
     except Exception as e:  # noqa: BLE001 — any failure means "don't use it"
+        return _err(e)
+
+
+@functools.lru_cache(maxsize=1)
+def probe_fused_q5k() -> str | None:
+    """Compile + run the fused Q5_K matmul at the serving tile geometry."""
+    try:
+        import jax.numpy as jnp
+
+        from ...gguf.quants import quant_q5_k
+        from .q5matmul import prep_q5k, q5k_matmul
+
+        rng = np.random.default_rng(0)
+        n = _probe_n()
+        w = prep_q5k(quant_q5_k(
+            rng.standard_normal(n * 2048).astype(np.float32) * 0.02),
+            n, 2048)
+        y = q5k_matmul(jnp.ones((1, 2048), jnp.bfloat16), w)
+        float(y.sum())
+        return None
+    except Exception as e:  # noqa: BLE001
         return _err(e)
 
 

@@ -1,0 +1,264 @@
+"""Fused Q5_K dequant-matmul (Pallas): completes the K-quant family.
+
+Q5_K_M files (the other common llama.cpp artifact besides the reference's
+Q4_K_M, reference api.py:14) store most linears as Q5_K.  Q5_K is Q4_K plus
+one high bit per weight (gguf/quants.py: ``q5 = nibble + 16·hibit`` ∈
+[0,32), same 8×32 sub-block scale/min structure, ``w = sc·q5 − mn``), so
+this kernel is the v2 Q4_K design (ops/pallas/qmatmul.py — float nibble
+split, lane-tiled scales, corrections folded into 128 extra K columns)
+with one addition: a packed hi-bit plane, eight bits per byte, split by a
+7-step ``floor`` chain (~1.9 VPU ops/weight extra) and folded into the
+dequant as ``hibit·(16·sc)``.  ≈ 0.75 B/weight in HBM vs int8's 1.0.
+
+Layout contract (:func:`prep_q5k`):
+
+- ``q5s`` (N, K/2) int8 — re-biased nibble bytes, EXACTLY the Q4_K
+  ``qs`` layout (column ``c = e·64 + s``, sub-block ``s = c % 64``).
+- ``q5h`` (N, K/8) int8 — hi-bit bytes: tile-local byte ``b`` ∈ [0,256)
+  holds bit ``j`` of columns ``b + 256·j``, stored biased (value − 128).
+- ``sm5`` (K/2048, N, 128) bf16 — [64 scales | 64 mins], identical to the
+  Q4_K ``sm``.
+
+Activation prep (permute + xsum augmentation) is byte-for-byte the Q4_K
+one, so the same prepared ``xpa`` could feed either kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
+from ...gguf.quants import unpack_scale_min_k4
+from .qmatmul import (
+    TK,
+    TKA,
+    _SUBS,
+    _interpret,
+    _pick_tn,
+    _spec_axis,
+    augment_x,
+    permute_x,
+    q4k_compatible,
+)
+
+q5k_compatible = q4k_compatible  # same divisibility classes
+
+
+# ---------------------------------------------------------------------------
+# host-side weight prep
+# ---------------------------------------------------------------------------
+
+def prep_q5k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
+    """Raw Q5_K block bytes (row-major, ``n_out`` rows of ``k_in`` elements)
+    → the kernel layout dict {"q5s", "q5h", "sm5"}."""
+    if not q5k_compatible(n_out, k_in):
+        raise ValueError(f"({n_out}, {k_in}) not fused-Q5_K compatible "
+                         f"(need K%{TK}==0, N%128==0)")
+    bs = GGML_BLOCK_SIZES[GGMLType.Q5_K][1]           # 176
+    nb = k_in // QK_K
+    kt = k_in // TK
+    blocks = np.ascontiguousarray(raw, dtype=np.uint8)[: n_out * nb * bs]
+    blocks = blocks.reshape(n_out, nb, bs)
+    d = blocks[..., 0:2].copy().view(np.float16).astype(np.float32)[..., 0]
+    dmin = blocks[..., 2:4].copy().view(np.float16).astype(np.float32)[..., 0]
+    sc, mn = unpack_scale_min_k4(blocks[..., 4:16])   # (N, nb, 8) uint8
+    sm = np.concatenate([
+        (d[..., None] * sc.astype(np.float32)).reshape(n_out, kt, _SUBS),
+        (dmin[..., None] * mn.astype(np.float32)).reshape(n_out, kt, _SUBS),
+    ], axis=-1).transpose(1, 0, 2)                    # (kt, N, 128)
+
+    # 5-bit values per (sub-block, element): nibble file layout is Q4_K's
+    # (byte g*32+i: sub 2g lo, sub 2g+1 hi); qh bit j = sub-block j's hi bit
+    fqs = blocks[..., 48:].reshape(n_out, nb, 4, 32)
+    q5 = np.empty((n_out, nb, 8, 32), dtype=np.uint8)
+    q5[:, :, 0::2, :] = fqs & 0x0F
+    q5[:, :, 1::2, :] = (fqs >> 4) & 0x0F
+    qh = blocks[..., 16:48].reshape(n_out, nb, 1, 32)
+    shifts = np.arange(8, dtype=np.uint8).reshape(1, 1, 8, 1)
+    q5 |= (((qh >> shifts) & 1) << 4)
+
+    # element-major tile columns (same map as Q4_K): Q[..., e, s]
+    Q = q5.reshape(n_out, kt, 8, 8, 32).transpose(0, 1, 4, 2, 3)
+    Q = np.ascontiguousarray(Q).reshape(n_out, kt, 32, 64)
+    nib = Q & 0x0F
+    hb = Q >> 4                                       # ∈ {0, 1}
+    lo = nib[:, :, :16, :].reshape(n_out, kt, TK // 2)
+    hi = nib[:, :, 16:, :].reshape(n_out, kt, TK // 2)
+    v4 = ((hi.astype(np.int16) - 8) << 4) + lo
+    q5s = v4.astype(np.int8).reshape(n_out, k_in // 2)
+
+    hbc = hb.reshape(n_out, kt, TK)                   # column-major bits
+    hbj = hbc.reshape(n_out, kt, 8, 256).astype(np.int16)  # [j, byte]
+    v1 = (hbj << np.arange(8, dtype=np.int16).reshape(1, 1, 8, 1)).sum(2) - 128
+    q5h = v1.astype(np.int8).reshape(n_out, k_in // 8)
+    return {
+        "q5s": jnp.asarray(q5s),
+        "q5h": jnp.asarray(q5h),
+        "sm5": jnp.asarray(np.ascontiguousarray(sm), dtype=jnp.bfloat16),
+    }
+
+
+def dequant_ref5(w: dict) -> jax.Array:
+    """(N, K) f32 dequantized weights in **permuted** column order."""
+    N, half = w["q5s"].shape
+    kt = half // (TK // 2)
+    v4 = w["q5s"].astype(jnp.float32).reshape(N, kt, TK // 2)
+    h = jnp.floor(v4 / 16.0)
+    nib = jnp.concatenate([v4 - 16.0 * h, h + 8.0], axis=2)   # (N, kt, TK)
+    u = w["q5h"].astype(jnp.float32).reshape(N, kt, 1, 256) + 128.0
+    bits = []
+    for j in range(7, -1, -1):
+        bj = jnp.floor(u / float(1 << j))
+        u = u - bj * float(1 << j)
+        bits.append(bj)
+    hb = jnp.concatenate(list(reversed(bits)), axis=2).reshape(N, kt, TK)
+    q5 = nib + 16.0 * hb
+    sm = jnp.transpose(w["sm5"], (1, 0, 2)).astype(jnp.float32)
+    sc = jnp.tile(sm[..., :_SUBS], (1, 1, TK // _SUBS))
+    mn = jnp.tile(sm[..., _SUBS:], (1, 1, TK // _SUBS))
+    return (q5 * sc - mn).reshape(N, kt * TK)
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _q5k_matmul_kernel(xpa_ref, q5s_ref, q5h_ref, sm_ref, o_ref, *, interpret):
+    TN = q5s_ref.shape[0]
+    v4 = q5s_ref[...].astype(jnp.float32)             # (TN, TK/2)
+    h = jnp.floor(v4 * 0.0625)
+    l = v4 - h * 16.0
+
+    u = q5h_ref[...].astype(jnp.float32) + 128.0      # (TN, TK/8)
+    bits = []
+    for j in range(7, -1, -1):                        # bit7 .. bit0
+        bj = jnp.floor(u * (1.0 / (1 << j)))
+        u = u - bj * float(1 << j)
+        bits.append(bj)
+    hb = jnp.concatenate(list(reversed(bits)), axis=1)  # (TN, TK) col-major
+
+    sm = sm_ref[...].reshape(TN, 128)
+    sc, mn = sm[:, :_SUBS], sm[:, _SUBS:]
+    sc2 = jnp.concatenate([sc, sc], axis=1)           # (TN, 128)
+    if interpret:
+        sc_exp = jnp.tile(sc2, (1, TK // 256)).astype(jnp.float32)
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+
+        sc_exp = pltpu.repeat(sc2, TK // 256, axis=1).astype(jnp.float32)
+    sc16 = sc_exp * 16.0
+    a_lo = (l * sc_exp + hb[:, : TK // 2] * sc16).astype(jnp.bfloat16)
+    a_hi = (h * sc_exp + hb[:, TK // 2:] * sc16).astype(jnp.bfloat16)
+    corr = jnp.concatenate([-mn, sc * 8.0], axis=1).astype(jnp.bfloat16)
+
+    xpa = xpa_ref[...]
+    part = jax.lax.dot_general(
+        xpa[:, : TK // 2], a_lo, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    part += jax.lax.dot_general(
+        xpa[:, TK // 2: TK], a_hi, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    part += jax.lax.dot_general(
+        xpa[:, TK:], corr, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] += part
+
+
+def _q5k_2d_raw(xpa: jax.Array, q5s: jax.Array, q5h: jax.Array,
+                sm: jax.Array, interpret: bool) -> jax.Array:
+    B, KA = xpa.shape
+    K = (KA // TKA) * TK
+    N = q5s.shape[0]
+    TN = _pick_tn(N, interpret, prefs=(256, 128))
+    grid = (N // TN, K // TK)
+    return pl.pallas_call(
+        functools.partial(_q5k_matmul_kernel, interpret=interpret),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B, TKA), lambda n, k: (0, k)),
+            pl.BlockSpec((TN, TK // 2), lambda n, k: (n, k)),
+            pl.BlockSpec((TN, TK // 8), lambda n, k: (n, k)),
+            pl.BlockSpec((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, TN), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
+        interpret=interpret,
+    )(xpa, q5s, q5h, sm)
+
+
+@functools.lru_cache(maxsize=4)
+def _q5k_2d_partitioned(interpret: bool):
+    """GSPMD rule mirroring the Q4_K kernel's: partition over N (and rows),
+    never over K; tp-sharded weights compute locally."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @custom_partitioning
+    def fn(xpa, q5s, q5h, sm):
+        return _q5k_2d_raw(xpa, q5s, q5h, sm, interpret)
+
+    def partition(mesh, arg_shapes, result_shape):
+        xp_s, qs_s, qh_s, sm_s = (a.sharding for a in arg_shapes)
+        rows = _spec_axis(xp_s, 0)
+        n_ax = _spec_axis(qs_s, 0)
+        arg_shardings = (
+            NamedSharding(mesh, P(rows, None)),
+            NamedSharding(mesh, P(n_ax, None)),
+            NamedSharding(mesh, P(n_ax, None)),
+            NamedSharding(mesh, P(None, n_ax, None)),
+        )
+        result_sharding = NamedSharding(mesh, P(rows, n_ax))
+
+        def lower(xpa, q5s, q5h, sm):
+            return _q5k_2d_raw(xpa, q5s, q5h, sm, interpret)
+
+        return mesh, lower, result_sharding, arg_shardings
+
+    def infer(mesh, arg_shapes, result_shape):
+        return NamedSharding(
+            mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
+                    _spec_axis(arg_shapes[1].sharding, 0)))
+
+    fn.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule="b k, n j, n p, t n l -> b n",
+    )
+    return jax.jit(fn)
+
+
+_MAX_B5 = 128
+
+
+def q5k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:
+    """x (..., K) bf16/f32 → (..., N) in x.dtype, weights in Q5_K kernel
+    layout.  The fused path of ``ops.linear.linear`` for Q5_K tensors."""
+    K = x.shape[-1]
+    lead = x.shape[:-1]
+    xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
+    itp = _interpret(interpret)
+    fn = _q5k_2d_partitioned(itp)
+    B = xpa.shape[0]
+    if B <= _MAX_B5:
+        y = fn(xpa, w["q5s"], w["q5h"], w["sm5"])
+    else:
+        pad = (-B) % _MAX_B5
+        if pad:
+            xpa = jnp.concatenate(
+                [xpa, jnp.zeros((pad, xpa.shape[1]), xpa.dtype)], axis=0)
+        chunks = [
+            fn(xpa[i:i + _MAX_B5], w["q5s"], w["q5h"], w["sm5"])
+            for i in range(0, B + pad, _MAX_B5)
+        ]
+        y = jnp.concatenate(chunks, axis=0)[:B]
+    return y.reshape(*lead, -1).astype(x.dtype)

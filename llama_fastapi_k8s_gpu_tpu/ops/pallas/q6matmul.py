@@ -211,7 +211,7 @@ def _q6k_2d_raw(xpa: jax.Array, q4: jax.Array, q2: jax.Array, sm: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA6) * TK
     N = q4.shape[0]
-    TN = _pick_tn(N, interpret)
+    TN = _pick_tn(N, interpret, prefs=(256, 128))
     grid = (N // TN, K // TK)
     return pl.pallas_call(
         functools.partial(_q6k_matmul_kernel, interpret=interpret),
@@ -269,7 +269,7 @@ def _q6k_2d_partitioned(interpret: bool):
     return jax.jit(fn)
 
 
-_MAX_B6 = 256
+_MAX_B6 = 128
 
 
 def q6k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:

@@ -223,8 +223,12 @@ def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret):
     o_ref[...] += part
 
 
-def _pick_tn(n: int, interpret: bool) -> int:
-    for c in (512, 256, 128) + ((64, 32, 16, 8) if interpret else ()):
+def _pick_tn(n: int, interpret: bool, prefs: tuple = (512, 256, 128)) -> int:
+    """Largest N tile that divides ``n``.  512 measured fastest for the
+    Q4_K kernel (docs/bench/qmatmul_v2_microbench_2026-07-29.json); the
+    Q6_K kernel passes smaller ``prefs`` because its wider f32
+    intermediates would crowd the ~16 MB VMEM at TN=512."""
+    for c in prefs + ((64, 32, 16, 8) if interpret else ()):
         if n % c == 0:
             return c
     raise ValueError(f"N={n} not divisible by 128")
@@ -308,8 +312,9 @@ def _q4k_2d_partitioned(interpret: bool):
     return jax.jit(fn)
 
 
-_MAX_B = 256  # rows per kernel call: bounds the xpa/out VMEM blocks (the
-              # weight tiles dominate; a (256, 2176) bf16 xpa block is ~1 MiB)
+_MAX_B = 128  # rows per kernel call: bounds the xpa/out VMEM blocks (the
+              # weight-tile intermediates dominate at ~10 MB of the ~16 MB
+              # VMEM with TN=512, so the activation side stays small)
 
 
 def q4k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:

@@ -60,23 +60,27 @@ def _linear_sharding(mesh: Mesh, col_parallel: bool) -> dict:
     small activations beats all-gathering the quantized weights by ~3 orders
     of magnitude at decode (B=1: KBs of activations vs GBs of weights).
     """
+    # fused layouts ({qs,sm} Q4_K / {q5s,q5h,sm5} Q5_K / {q4,q2,sm6} Q6_K)
+    # shard their OUTPUT dim in both cases — see the docstring above
+    fused_col = {
+        "qs": _ns(mesh, None, "tp", None),
+        "sm": _ns(mesh, None, None, "tp", None),
+        "q5s": _ns(mesh, None, "tp", None),
+        "q5h": _ns(mesh, None, "tp", None),
+        "sm5": _ns(mesh, None, None, "tp", None),
+        "q4": _ns(mesh, None, "tp", None),
+        "q2": _ns(mesh, None, "tp", None),
+        "sm6": _ns(mesh, None, None, "tp", None),
+    }
     if col_parallel:
         return {"w": _ns(mesh, None, "tp", None),
                 "q": _ns(mesh, None, "tp", None),
                 "s": _ns(mesh, None, "tp"),
-                "qs": _ns(mesh, None, "tp", None),
-                "sm": _ns(mesh, None, None, "tp", None),
-                "q4": _ns(mesh, None, "tp", None),
-                "q2": _ns(mesh, None, "tp", None),
-                "sm6": _ns(mesh, None, None, "tp", None)}
+                **fused_col}
     return {"w": _ns(mesh, None, None, "tp"),
             "q": _ns(mesh, None, None, "tp"),
             "s": _ns(mesh, None, None),
-            "qs": _ns(mesh, None, "tp", None),
-            "sm": _ns(mesh, None, None, "tp", None),
-            "q4": _ns(mesh, None, "tp", None),
-            "q2": _ns(mesh, None, "tp", None),
-            "sm6": _ns(mesh, None, None, "tp", None)}
+            **fused_col}
 
 
 def _match_linear(shardings: dict, linear: dict) -> dict:
@@ -100,6 +104,8 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
     head = {"w": _ns(mesh, "tp", None), "q": _ns(mesh, "tp", None),
             "s": _ns(mesh, "tp"), "qs": _ns(mesh, "tp", None),
             "sm": _ns(mesh, None, "tp", None),
+            "q5s": _ns(mesh, "tp", None), "q5h": _ns(mesh, "tp", None),
+            "sm5": _ns(mesh, None, "tp", None),
             "q4": _ns(mesh, "tp", None), "q2": _ns(mesh, "tp", None),
             "sm6": _ns(mesh, None, "tp", None)}
     out_shard = {k: head[k] for k in out}
@@ -155,7 +161,7 @@ def _fit_sharding(arr, ns: NamedSharding) -> NamedSharding:
     return NamedSharding(mesh, P(*fixed))
 
 
-_FUSED_MAIN_KEY = {"qs": "qs", "q4": "q4"}   # fused layout → its (…,N,K/x) leaf
+_FUSED_MAIN_KEY = {"qs": "qs", "q4": "q4", "q5s": "q5s"}  # layout → (…,N,K/x) leaf
 
 
 def _fused_key(p: dict) -> str | None:
