@@ -198,6 +198,16 @@ def _fit_q4k(leaf: dict, shard: dict) -> dict:
             for k in leaf}
 
 
+def shard_fused_linear(w: dict, mesh: Mesh, axis: str = "tp") -> dict:
+    """Shardings for ONE unstacked fused-layout linear ({qs,sm} /
+    {q5s,q5h,sm5} / {q4,q2,sm6} without the layer dim): quantized planes
+    (N, K/x) shard their output dim N; scale tables (kt, N, 128) shard N in
+    the middle.  The single source for tests/dryruns that shard a bare
+    fused dict — the stacked serving path uses :func:`param_shardings`."""
+    return {k: (_ns(mesh, axis, None) if w[k].ndim == 2
+                else _ns(mesh, None, axis, None)) for k in w}
+
+
 def fit_shardings(params: dict, shardings: dict) -> dict:
     def fit(p, s):
         if isinstance(p, dict) and _fused_key(p):

@@ -534,11 +534,34 @@ def create_app(engine=None, settings: Settings | None = None,
         queue_depth = st.queue.qsize() if hasattr(st, "queue") else None
         if not st.ready:
             raise HTTPException(status_code=503, detail="model loading")
+        eng = st.engine
+        engine_info = None
+        if eng is not None:
+            cfg = getattr(eng, "cfg", None)
+            # which linear layout each weight group actually serves with
+            # (fused kernels may have probe-degraded to int8 — visible here)
+            fmt = None
+            params = getattr(eng, "params", None)
+            if isinstance(params, dict) and "layers" in params:
+                kinds = {"qs": "q4k-fused", "q5s": "q5k-fused",
+                         "q4": "q6k-fused", "q": "int8", "w": "bf16"}
+                fmt = {
+                    name: next((v for k, v in kinds.items() if k in leaf), "?")
+                    for name, leaf in params["layers"].items()
+                    if isinstance(leaf, dict)
+                }
+            engine_info = {
+                "model": getattr(eng, "model_name", None),
+                "n_ctx": getattr(cfg, "n_ctx", None),
+                "attn_impl": getattr(cfg, "attn_impl", None),
+                "weight_formats": fmt,
+            }
         return {
             "status": "ok",
-            "model_loaded": st.engine is not None,
+            "model_loaded": eng is not None,
             "queue_depth": queue_depth,
             "max_queue_size": st.settings.max_queue_size,
+            "engine": engine_info,
         }
 
     @app.get("/metrics")

@@ -87,3 +87,33 @@ def test_dp_tp_batched_serving_step(params, mesh):
     assert (toks >= 0).all() and (toks < CFG.vocab_size).all()
     # greedy + identical rows → identical continuations
     assert (toks == toks[:, :1]).all()
+
+
+# ---------------------------------------------------------------------------
+# fused-kernel GSPMD rules: tp-sharded weights must compute locally and
+# match the unsharded result (custom_partitioning in ops/pallas/q*matmul.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("maker_name", ["q4k", "q5k", "q6k"])
+def test_fused_matmul_partitioned_matches_unsharded(maker_name):
+    from llama_fastapi_k8s_gpu_tpu.ops import (
+        make_linear_q4k,
+        make_linear_q5k,
+        make_linear_q6k,
+    )
+    from llama_fastapi_k8s_gpu_tpu.ops.linear import linear
+    from llama_fastapi_k8s_gpu_tpu.parallel.mesh import shard_fused_linear
+
+    maker = {"q4k": make_linear_q4k, "q5k": make_linear_q5k,
+             "q6k": make_linear_q6k}[maker_name]
+    rng = np.random.default_rng(5)
+    wf = rng.standard_normal((256, 2048)).astype(np.float32) * 2048 ** -0.5
+    w = maker(wf)
+    x = jnp.asarray(rng.standard_normal((3, 2048)), jnp.bfloat16)
+    ref = np.asarray(linear(x, w).astype(jnp.float32))
+
+    mesh = make_mesh(dp=1, tp=2)
+    ws = jax.device_put(w, shard_fused_linear(w, mesh))
+    got = jax.jit(linear)(x, ws)
+    np.testing.assert_allclose(np.asarray(got.astype(jnp.float32)), ref,
+                               rtol=2e-2, atol=2e-2 * np.abs(ref).max())

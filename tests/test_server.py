@@ -259,3 +259,29 @@ async def test_response_stream_engine_error_event():
             assert r.status_code == 200
             assert "boom" in r.text
         await app.router.shutdown()
+
+
+@pytest.mark.anyio
+async def test_health_reports_engine_config(tmp_path):
+    """/health exposes the served config (attn impl, per-group weight
+    layouts incl. probe degradations) for operability; tolerant of engines
+    without params (fakes)."""
+    from llama_fastapi_k8s_gpu_tpu.engine import Engine
+    from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    engine = Engine(path, n_ctx=128, prefill_buckets=(32,))
+    app, transport = make_client(engine)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            r = await client.get("/health")
+            assert r.status_code == 200
+            eng = r.json()["engine"]
+            assert eng["n_ctx"] == 128
+            assert eng["attn_impl"] in ("xla", "pallas")
+            assert set(eng["weight_formats"]) >= {"wq", "w_gate", "w_down"}
+            assert all(v in ("q4k-fused", "q5k-fused", "q6k-fused",
+                             "int8", "bf16") for v in eng["weight_formats"].values())
+        await app.router.shutdown()
