@@ -59,6 +59,54 @@ def _setup_compile_cache():
         logger.warning("compilation cache unavailable: %s", e)
 
 
+class _TextEmitter:
+    """Incremental text emission shared by the pipelined (:meth:`Engine._run`)
+    and speculative (:meth:`Engine._run_spec`) decode loops: append-only
+    token list → (ready_text, stop_hit) increments via an incremental UTF-8
+    decoder with stop-string prefix holdback, plus the final flush.
+    Extracted so the two loops cannot drift."""
+
+    def __init__(self, engine: "Engine", stops):
+        self._eng = engine
+        self._stops = stops
+        self._dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
+        self._sent_bytes = 0
+        self._held = ""        # withheld text (possible stop-string prefix)
+        self._n_emitted = 0    # characters already yielded
+
+    def stop_hit(self, gen: list) -> bool:
+        """Whether a stop string appears in the decoded stream (pure check:
+        ``final`` produces the clipped tail)."""
+        text = self._eng.tokenizer.decode_bytes(gen).decode(
+            "utf-8", errors="replace")
+        return self._eng._find_stop_str(text, self._stops) != -1
+
+    def emit(self, gen: list) -> str:
+        """Text newly ready to stream out.  The caller MUST yield it — the
+        returned characters are counted as emitted (``final`` won't repeat
+        them); call only while the stream is live."""
+        eng = self._eng
+        bts = eng.tokenizer.decode_bytes(gen)
+        self._held += self._dec.decode(bts[self._sent_bytes:])
+        self._sent_bytes = len(bts)
+        hold = eng._stop_prefix_holdback(self._held, self._stops)
+        ready = self._held[:len(self._held) - hold]
+        self._held = self._held[len(self._held) - hold:]
+        self._n_emitted += len(ready)
+        return ready
+
+    def final(self, gen: list, finish: str) -> tuple[str, str]:
+        """(text_tail, finish) once generation has ended: decode the whole
+        stream, clip at a stop string, return what was never emitted."""
+        text = self._eng._decode_text(gen)
+        cut = self._eng._find_stop_str(text, self._stops)
+        if cut != -1:
+            text = text[:cut]
+            finish = "stop"
+        tail = text[self._n_emitted:] if len(text) > self._n_emitted else ""
+        return tail, finish
+
+
 class Engine:
     """Loads a GGUF model and serves chat completions on the local device(s)."""
 
@@ -72,12 +120,21 @@ class Engine:
         max_gen_tokens: int = 512,
         seed: int = 0,
         attn_impl: str = "auto",  # auto | xla | pallas (prefill flash kernel)
+        spec_decode: str = "off",  # off | lookup (prompt-lookup speculation)
+        spec_draft: int = 8,
         *,
         _parts: tuple | None = None,  # (params, cfg, tokenizer, template_kind)
     ):
         self.n_ctx = n_ctx
         self.decode_chunk = decode_chunk
         self.max_gen_tokens = max_gen_tokens
+        if spec_decode not in ("off", "lookup"):
+            raise ValueError(
+                f"spec_decode must be off|lookup, got {spec_decode!r}")
+        if spec_decode == "lookup" and not 1 <= spec_draft < n_ctx - 1:
+            raise ValueError(
+                f"spec_draft must be in [1, n_ctx-2], got {spec_draft}")
+        self._spec_draft = spec_draft if spec_decode == "lookup" else 0
         self._lock = threading.Lock()
         self._base_seed = seed
         # request counter: shared by the serial path (caller thread) and the
@@ -218,9 +275,11 @@ class Engine:
 
     def warmup(self):
         """Compile every (bucket, chunk) shape so no request pays a cold
-        compile — the TPU analogue of the reference's eager model load."""
+        compile — the TPU analogue of the reference's eager model load.
+        The warmup prompt repeats a word so that, with speculation enabled,
+        the n-gram lookup hits and ``spec_verify_jit`` compiles here too."""
         t0 = time.time()
-        msgs = [{"role": "user", "content": "hi"}]
+        msgs = [{"role": "user", "content": "hi hi hi hi hi hi hi hi"}]
         self.create_chat_completion(msgs, max_tokens=self.decode_chunk + 1,
                                     temperature=0.0)
         for b in self.prefill_buckets[1:]:
@@ -329,7 +388,8 @@ class Engine:
         first = int(token)  # device sync: first token is now materialized
         return {
             "state": state, "st": st, "sp": sp, "n_prompt": n_prompt,
-            "ids": [], "first": first, "t0": t0, "ttft_s": time.time() - t0,
+            "ids": [], "prompt_ids": ids, "first": first, "t0": t0,
+            "ttft_s": time.time() - t0,
         }
 
     def _finish(self, ctx) -> dict:
@@ -387,6 +447,114 @@ class Engine:
         n = min(n, self.cfg.n_ctx - pos - 1)  # cache slots n_prompt..n_ctx-1
         return max(0, n)
 
+    # -- speculative decoding (prompt-lookup drafts) --------------------
+
+    def _spec_enabled(self) -> bool:
+        """Lookup speculation calls ``spec_verify_jit`` on ``self.params``
+        directly, which is only valid for the plain serial engine — mesh/
+        continuous/sequence-parallel engines hold sharded params and route
+        their device calls differently, so they serve vanilla decode even
+        if constructed with ``spec_decode="lookup"``."""
+        return self._spec_draft > 0 and type(self) is Engine
+
+    @staticmethod
+    def _lookup_draft(history: list, D: int, max_ngram: int = 3):
+        """Prompt-lookup draft: find the most recent earlier occurrence of
+        the last n-gram (n = max_ngram..1) in ``history`` and propose its
+        continuation, zero-padded to exactly ``D`` tokens (static verify
+        shape).  Returns None when no n-gram recurs — the caller falls back
+        to plain decode.  The same heuristic as llama.cpp's lookup-decoding
+        example: free drafts from the prompt's own repetitions (chat
+        history re-sent every turn, code identifiers, quoted spans)."""
+        n_hist = len(history)
+        for n in range(max_ngram, 0, -1):
+            if n_hist < n + 1:
+                continue
+            pat = history[-n:]
+            for j in range(n_hist - n - 1, -1, -1):
+                if history[j:j + n] == pat:
+                    cont = history[j + n:j + n + D]
+                    if cont:
+                        return cont + [0] * (D - len(cont))
+        return None
+
+    def _run_spec(self, ctx, max_tokens, stops):
+        """Speculative variant of :meth:`_run` (LFKT_SPEC_DECODE=lookup).
+
+        Each iteration drafts up to ``spec_draft`` next tokens from n-gram
+        repetition in prompt+generation, verifies them in ONE forward
+        (models/generate.spec_verify_jit) and emits the agreeing prefix +
+        one true sample — so a hit advances several tokens for one weight
+        read, and a miss costs one (wider) decode step.  Greedy output is
+        identical to the vanilla path; sampled output is equal in
+        distribution (same PRNG folds/window/conditioning, logits modulo
+        batched-forward float reordering — see spec_verify_jit).
+
+        NOT pipelined, unlike :meth:`_run`: the draft for step k+1 needs
+        step k's accepted tokens on the host, so dispatch is sequential —
+        speculation trades the overlapped round-trip for multi-token steps.
+        """
+        from ..models.generate import spec_verify_jit
+
+        stop_ids = self.tokenizer.stop_ids
+        budget = self._token_budget(max_tokens, ctx["n_prompt"])
+        gen: list[int] = []
+        em = _TextEmitter(self, stops)
+        finish = "length"
+        first = ctx["first"]
+        if budget <= 0:
+            yield "", True, "length"
+            return
+        if first in stop_ids:
+            yield "", True, "stop"
+            return
+        gen.append(first)
+        history = list(ctx["prompt_ids"]) + gen
+        pos = ctx["n_prompt"]
+        D = self._spec_draft
+        done = len(gen) >= budget
+        while not done:
+            remaining = budget - len(gen)
+            capacity = self.cfg.n_ctx - pos - 1   # cache slots left to write
+            draft = (self._lookup_draft(history, D)
+                     if remaining > 1 and capacity > D else None)
+            if draft is not None:
+                ctx["state"], toks, cnt = spec_verify_jit(
+                    self.params, self.cfg, ctx["state"], ctx["st"],
+                    jnp.asarray(draft, jnp.int32), top_k=ctx["sp"].top_k)
+                cnt = int(cnt)                    # host sync
+                toks = np.asarray(toks)[:min(cnt, remaining)].tolist()
+                pos += cnt
+            else:
+                n = self._next_steps(len(gen), pos, budget)
+                if n <= 0:
+                    break
+                ctx["state"], t = self._decode_chunk_call(
+                    ctx["state"], ctx["st"], n, ctx["sp"].top_k)
+                toks = np.asarray(t).tolist()
+                pos += n
+            for t in toks:
+                if t in stop_ids:
+                    finish = "stop"
+                    done = True
+                    break
+                gen.append(t)
+                history.append(t)
+            if not done and len(gen) >= budget:
+                done = True
+
+            if em.stop_hit(gen):
+                finish = "stop"
+                done = True
+            elif not done:
+                ready = em.emit(gen)
+                if ready:
+                    yield ready, False, finish
+
+        ctx["ids"] = gen
+        tail, finish = em.final(gen, finish)
+        yield tail, True, finish
+
     def _run(self, ctx, max_tokens, stops):
         """Generate tokens; yields (new_text, done, finish_reason) increments.
 
@@ -402,13 +570,13 @@ class Engine:
         is byte-identical to the one-shot decode even when a multi-byte
         character spans a chunk boundary.
         """
+        if self._spec_enabled():
+            yield from self._run_spec(ctx, max_tokens, stops)
+            return
         stop_ids = self.tokenizer.stop_ids
         budget = self._token_budget(max_tokens, ctx["n_prompt"])
         gen: list[int] = []
-        dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
-        n_emitted = 0    # characters already yielded
-        sent_bytes = 0   # bytes already fed to the incremental decoder
-        held = ""        # decoded text withheld (possible stop-string prefix)
+        em = _TextEmitter(self, stops)
         finish = "length"
         first = ctx["first"]
         if budget <= 0:
@@ -450,27 +618,17 @@ class Engine:
             if pending is None:
                 done = True
 
-            bts = self.tokenizer.decode_bytes(gen)
-            text = bts.decode("utf-8", errors="replace")
-            cut = self._find_stop_str(text, stops)
-            if cut != -1:
+            if em.stop_hit(gen):
                 finish = "stop"
                 done = True
             elif not done:
-                held += dec.decode(bts[sent_bytes:])
-                sent_bytes = len(bts)
-                hold = self._stop_prefix_holdback(held, stops)
-                ready, held = held[:len(held) - hold], held[len(held) - hold:]
+                ready = em.emit(gen)
                 if ready:
                     yield ready, False, finish
-                    n_emitted += len(ready)
 
-        text = self._decode_text(gen)
-        cut = self._find_stop_str(text, stops)
-        if cut != -1:
-            text = text[:cut]
         ctx["ids"] = gen
-        yield text[n_emitted:] if len(text) > n_emitted else "", True, finish
+        tail, finish = em.final(gen, finish)
+        yield tail, True, finish
 
     # ------------------------------------------------------------------
     def _generate(self, messages, sp, max_tokens, stops, seed) -> dict:

@@ -603,6 +603,8 @@ def _default_engine_factory(settings: Settings):
             prefill_buckets=settings.prefill_bucket_list,
             max_gen_tokens=settings.max_gen_tokens,
             attn_impl=settings.attn_impl,
+            spec_decode=settings.spec_decode,
+            spec_draft=settings.spec_draft,
         )
         if settings.scheduler not in ("continuous", "cycle"):
             raise ValueError(

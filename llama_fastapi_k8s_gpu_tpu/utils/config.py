@@ -54,6 +54,8 @@ class Settings:
     prefill_buckets: str = "128,256,512,1024"  # padded prompt shapes to bound recompiles
     weight_format: str = "auto"     # auto | bf16 | int8 | q4k
     attn_impl: str = "auto"         # auto | xla | pallas (prefill flash kernel)
+    spec_decode: str = "off"        # off | lookup — prompt-lookup speculative
+    spec_draft: int = 8             # draft tokens per verify step
     # >1 switches the server to mesh-batched serving — the v5e-4
     # "concurrent /response load" config.  scheduler picks the flavor:
     #   cycle      — MeshEngine: coalesce up to batch_size queued requests
@@ -99,6 +101,8 @@ def get_settings() -> Settings:
         prefill_buckets=_env("LFKT_PREFILL_BUCKETS", Settings.prefill_buckets),
         weight_format=_env("LFKT_WEIGHT_FORMAT", Settings.weight_format),
         attn_impl=_env("LFKT_ATTN_IMPL", Settings.attn_impl),
+        spec_decode=_env("LFKT_SPEC_DECODE", Settings.spec_decode),
+        spec_draft=_env("LFKT_SPEC_DRAFT", Settings.spec_draft, int),
         batch_size=_env("LFKT_BATCH_SIZE", Settings.batch_size, int),
         scheduler=_env("LFKT_SCHEDULER", Settings.scheduler),
         mesh_tp=_env("LFKT_MESH_TP", Settings.mesh_tp, int),
