@@ -74,26 +74,27 @@ class _TextEmitter:
         self._held = ""        # withheld text (possible stop-string prefix)
         self._n_emitted = 0    # characters already yielded
 
-    def stop_hit(self, gen: list) -> bool:
-        """Whether a stop string appears in the decoded stream (pure check:
-        ``final`` produces the clipped tail)."""
-        text = self._eng.tokenizer.decode_bytes(gen).decode(
-            "utf-8", errors="replace")
-        return self._eng._find_stop_str(text, self._stops) != -1
+    def process(self, gen: list, live: bool) -> tuple[str, bool]:
+        """One decode of the token stream → (ready_text, stop_hit).
 
-    def emit(self, gen: list) -> str:
-        """Text newly ready to stream out.  The caller MUST yield it — the
-        returned characters are counted as emitted (``final`` won't repeat
-        them); call only while the stream is live."""
+        On a stop hit nothing is emitted (``final`` produces the clipped
+        tail).  When ``live`` is False only the stop check runs — returned
+        text would be dropped by the caller, so it must not be counted as
+        emitted.  The caller MUST yield a non-empty ``ready_text``."""
         eng = self._eng
         bts = eng.tokenizer.decode_bytes(gen)
+        text = bts.decode("utf-8", errors="replace")
+        if eng._find_stop_str(text, self._stops) != -1:
+            return "", True
+        if not live:
+            return "", False
         self._held += self._dec.decode(bts[self._sent_bytes:])
         self._sent_bytes = len(bts)
         hold = eng._stop_prefix_holdback(self._held, self._stops)
         ready = self._held[:len(self._held) - hold]
         self._held = self._held[len(self._held) - hold:]
         self._n_emitted += len(ready)
-        return ready
+        return ready, False
 
     def final(self, gen: list, finish: str) -> tuple[str, str]:
         """(text_tail, finish) once generation has ended: decode the whole
@@ -135,6 +136,11 @@ class Engine:
             raise ValueError(
                 f"spec_draft must be in [1, n_ctx-2], got {spec_draft}")
         self._spec_draft = spec_draft if spec_decode == "lookup" else 0
+        if self._spec_draft and type(self) is not Engine:
+            logger.warning(
+                "spec_decode='lookup' is only served by the serial Engine; "
+                "%s serves vanilla decode (see _spec_enabled)",
+                type(self).__name__)
         self._lock = threading.Lock()
         self._base_seed = seed
         # request counter: shared by the serial path (caller thread) and the
@@ -276,12 +282,18 @@ class Engine:
     def warmup(self):
         """Compile every (bucket, chunk) shape so no request pays a cold
         compile — the TPU analogue of the reference's eager model load.
-        The warmup prompt repeats a word so that, with speculation enabled,
-        the n-gram lookup hits and ``spec_verify_jit`` compiles here too."""
+        With speculation enabled this drives BOTH decode paths: a
+        repeated-word prompt whose n-gram lookup hits (compiles
+        ``spec_verify_jit``) and a unique-word prompt whose lookup misses
+        (compiles the plain chunk fallback)."""
         t0 = time.time()
         msgs = [{"role": "user", "content": "hi hi hi hi hi hi hi hi"}]
         self.create_chat_completion(msgs, max_tokens=self.decode_chunk + 1,
                                     temperature=0.0)
+        if self._spec_enabled():
+            self.create_chat_completion(
+                [{"role": "user", "content": "alpha bravo charlie delta"}],
+                max_tokens=self.decode_chunk + 1, temperature=0.0)
         for b in self.prefill_buckets[1:]:
             ids = [0] * (b - 1)
             cache = self._cache
@@ -543,13 +555,12 @@ class Engine:
             if not done and len(gen) >= budget:
                 done = True
 
-            if em.stop_hit(gen):
+            ready, hit = em.process(gen, live=not done)
+            if hit:
                 finish = "stop"
                 done = True
-            elif not done:
-                ready = em.emit(gen)
-                if ready:
-                    yield ready, False, finish
+            elif ready:
+                yield ready, False, finish
 
         ctx["ids"] = gen
         tail, finish = em.final(gen, finish)
@@ -618,13 +629,12 @@ class Engine:
             if pending is None:
                 done = True
 
-            if em.stop_hit(gen):
+            ready, hit = em.process(gen, live=not done)
+            if hit:
                 finish = "stop"
                 done = True
-            elif not done:
-                ready = em.emit(gen)
-                if ready:
-                    yield ready, False, finish
+            elif ready:
+                yield ready, False, finish
 
         ctx["ids"] = gen
         tail, finish = em.final(gen, finish)

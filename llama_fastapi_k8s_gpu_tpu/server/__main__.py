@@ -11,6 +11,15 @@ import os
 
 
 def main():
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # a site hook may pre-register a device platform and override the
+        # env var at startup; the post-import config update wins if no
+        # backend is initialized yet (same defense as tests/conftest.py
+        # and bench.py — without it, JAX_PLATFORMS=cpu silently attaches
+        # to the accelerator anyway)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     host = os.environ.get("LFKT_HOST", "0.0.0.0")
     port = int(os.environ.get("LFKT_PORT", "8000"))
     try:

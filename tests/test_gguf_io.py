@@ -85,3 +85,44 @@ def test_bad_magic(tmp_path):
     p.write_bytes(b"NOPE" + b"\x00" * 64)
     with pytest.raises(ValueError, match="not a GGUF"):
         GGUFFile(str(p))
+
+
+# ---------------------------------------------------------------------------
+# malformed input: the reader must fail with a clean ValueError (a corrupt
+# S3 download or truncated initContainer copy must not crash-loop the pod
+# with an opaque struct error — SURVEY.md §3.1 cold-start path)
+# ---------------------------------------------------------------------------
+
+def test_reader_rejects_truncated_header(tmp_path):
+    p = tmp_path / "trunc.gguf"
+    p.write_bytes(b"GGUF\x03\x00")     # magic + half a version field
+    with pytest.raises(ValueError):
+        GGUFFile(str(p))
+
+
+def test_reader_rejects_truncated_body(tmp_path):
+    from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+
+    full = tmp_path / "full.gguf"
+    write_tiny_llama_gguf(str(full))
+    data = full.read_bytes()
+    for frac in (0.3, 0.7):
+        cut = tmp_path / f"cut{frac}.gguf"
+        cut.write_bytes(data[: int(len(data) * frac)])
+        try:
+            gf = GGUFFile(str(cut))
+            # header may parse; tensor payloads must not read out of bounds
+            with pytest.raises((ValueError, IndexError)):
+                for name in list(gf.tensors):
+                    gf[name].astype_f32()
+        except ValueError:
+            pass  # rejected at parse time: equally fine
+
+
+def test_reader_rejects_unsupported_version(tmp_path):
+    import struct
+
+    p = tmp_path / "v9.gguf"
+    p.write_bytes(b"GGUF" + struct.pack("<I", 9) + b"\x00" * 32)
+    with pytest.raises(ValueError, match="version"):
+        GGUFFile(str(p))

@@ -1,0 +1,84 @@
+"""End-to-end boot of ``python -m llama_fastapi_k8s_gpu_tpu.server`` — the
+actual pod entrypoint (SURVEY.md §1 L4; reference docker/Dockerfile.app:12)
+— against a real TCP socket with a tiny GGUF: startup (503 while loading →
+200), /response, /health engine info, clean shutdown."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+
+BODY = {
+    "bot_profile": {"name": "Ada", "appearance": "a,b,c,d"},
+    "user_profile": {"name": "Sam"},
+    "context": [{"turn": "user", "message": "hi"}],
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_python_m_server_serves(tmp_path):
+    model = tmp_path / "tiny.gguf"
+    write_tiny_llama_gguf(str(model))
+    port = _free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        LFKT_MODEL_DIR=str(tmp_path),
+        LFKT_MODEL_NAME="tiny.gguf",
+        LFKT_HOST="127.0.0.1",
+        LFKT_PORT=str(port),
+        LFKT_MAX_CONTEXT_TOKENS="512",   # byte-level system prompt ≈ 300 tok
+        LFKT_PREFILL_BUCKETS="128,512",
+        LFKT_MAX_GEN_TOKENS="8",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.server"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        deadline = time.time() + 240
+        status = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(base + "/health", timeout=5) as r:
+                    status = r.status
+                    body = json.loads(r.read())
+                    break
+            except urllib.error.HTTPError as e:
+                status = e.code          # 503 while the model loads is fine
+            except Exception:
+                pass
+            assert proc.poll() is None, proc.stdout.read()[-2000:]
+            time.sleep(0.5)
+        assert status == 200, status
+        assert body["model_loaded"] is True
+        assert body["engine"]["n_ctx"] == 512
+
+        req = urllib.request.Request(
+            base + "/response", data=json.dumps(BODY).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert isinstance(out["response"], str)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
