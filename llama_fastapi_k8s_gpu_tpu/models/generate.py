@@ -41,6 +41,18 @@ def prefill_jit(params, cfg: ModelConfig, tokens, length, cache):
     return prefill(params, cfg, tokens, length, cache)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill_chunk_jit(params, cfg: ModelConfig, tokens, pos_offset, last_idx,
+                      cache):
+    """One slice of a chunked prompt pass: ``tokens`` (C,) enter the cache
+    at ``pos_offset``; returns (logits at ``last_idx`` within the chunk,
+    cache).  The continuous scheduler prefills admissions in these chunks
+    so live lanes' decode interleaves instead of stalling for a whole
+    bucket (engine/continuous.py); callers discard the logits of every
+    chunk except the one containing the prompt's last real token."""
+    return forward(params, cfg, tokens, pos_offset, cache, last_idx=last_idx)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "top_k"))
 def sample_jit(logits, window, wpos, key, st, cfg: ModelConfig, top_k: int = 40):
     """Sample the first token (from prefill logits) and update sampler state."""

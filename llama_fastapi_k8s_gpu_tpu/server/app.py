@@ -619,10 +619,14 @@ def _default_engine_factory(settings: Settings):
             eng = SPEngine(settings.model_path, sp=settings.mesh_sp,
                            tp=settings.mesh_tp, **kw)
         elif settings.batch_size > 1:
-            cls = (ContinuousEngine if settings.scheduler == "continuous"
-                   else MeshEngine)
-            eng = cls(settings.model_path, tp=settings.mesh_tp,
-                      batch_size=settings.batch_size, **kw)
+            if settings.scheduler == "continuous":
+                eng = ContinuousEngine(
+                    settings.model_path, tp=settings.mesh_tp,
+                    batch_size=settings.batch_size,
+                    prefill_chunk=settings.prefill_chunk, **kw)
+            else:
+                eng = MeshEngine(settings.model_path, tp=settings.mesh_tp,
+                                 batch_size=settings.batch_size, **kw)
         else:
             eng = Engine(settings.model_path, **kw)
         eng.warmup()

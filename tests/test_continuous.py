@@ -300,24 +300,25 @@ def test_shutdown_resolves_outstanding(tmp_path):
 
 def test_decode_progresses_during_admission_wave(cengine):
     """VERDICT r2 weak #4: live lanes must keep decoding while a wave of
-    admissions prefills.  Simulated slow prefills (wrapping _admit_one with a
-    sleep) must NOT serialize into one long decode stall: with one admission
-    overlapped per chunk, a live stream's inter-chunk gap stays ~one
+    admissions prefills.  Simulated slow prefills (wrapping
+    _dispatch_prefill_chunk with a sleep; the test buckets are one slice
+    each) must NOT serialize into one long decode stall: with one admission
+    slice overlapped per chunk, a live stream's inter-chunk gap stays ~one
     admission, where the round-2 loop stalled for the whole wave."""
     import time as _time
 
     delay = 0.25
     n_wave = 4
-    orig = cengine._admit_one
+    orig = cengine._dispatch_prefill_chunk
     admitted = []
 
-    def slow_admit(lane, item):
+    def slow_chunk(adm):
         if admitted:          # first request admits fast; the wave is slow
             _time.sleep(delay)
-        admitted.append(lane)
-        return orig(lane, item)
+        admitted.append(adm["n_prompt"])
+        return orig(adm)
 
-    cengine._admit_one = slow_admit
+    cengine._dispatch_prefill_chunk = slow_chunk
     try:
         stream = cengine.submit_stream(
             [{"role": "user", "content": "stream me"}],
@@ -342,4 +343,58 @@ def test_decode_progresses_during_admission_wave(cengine):
         # prefills back-to-back; new behavior bounds any gap near one delay.
         assert max(gaps) < (n_wave - 1) * delay, gaps
     finally:
-        cengine._admit_one = orig
+        cengine._dispatch_prefill_chunk = orig
+
+
+def test_chunked_prefill_bounds_stall_per_slice(tmp_path):
+    """A long-prompt admission prefills in slices: live lanes' inter-chunk
+    gap is bounded by ~one slice, not the whole bucket (the second half of
+    VERDICT r2 weak #4 — vLLM-style chunked prefill)."""
+    import time as _time
+
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    eng = ContinuousEngine(path, dp=2, tp=2, batch_size=2, n_ctx=128,
+                           decode_chunk=4, max_gen_tokens=24,
+                           prefill_buckets=(64,), prefill_chunk=16)
+    try:
+        # compile the slice/decode programs first so measured gaps are
+        # steady-state scheduling, not first-use jit compiles
+        eng.submit([{"role": "user", "content": "y " * 40}],
+                   temperature=0.0, max_tokens=2).result(timeout=300)
+
+        delay = 0.15
+        orig = eng._dispatch_prefill_chunk
+        n_slices = []
+
+        def slow_chunk(adm):
+            if n_slices:                 # first admission (the stream) is fast
+                _time.sleep(delay)
+            n_slices.append(adm["offset"])
+            return orig(adm)
+
+        eng._dispatch_prefill_chunk = slow_chunk
+        stream = eng.submit_stream(
+            [{"role": "user", "content": "stream me"}],
+            temperature=0.0, max_tokens=20)
+        it = iter(stream)
+        next(it)                          # admitted + decoding
+        gaps = []
+        t_prev = _time.perf_counter()
+        fut = None
+        for i, _chunk in enumerate(it):
+            now = _time.perf_counter()
+            gaps.append(now - t_prev)
+            t_prev = now
+            if i == 0:   # long prompt: bucket 64 / slice 16 = 4 slices
+                fut = eng.submit(
+                    [{"role": "user", "content": "x " * 40}],
+                    temperature=0.0, max_tokens=2)
+        assert fut is not None
+        fut.result(timeout=120)
+        assert len([o for o in n_slices if o == 0]) >= 2  # 2nd admission ran
+        # a 4-slice admission done in ONE stall would gap >= 4*delay; chunked
+        # interleaving keeps every gap near one slice
+        assert max(gaps) < 3 * delay, gaps
+    finally:
+        eng.shutdown()
