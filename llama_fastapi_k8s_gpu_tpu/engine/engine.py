@@ -258,6 +258,7 @@ class Engine:
             probe_fused_q4k,
             probe_fused_q5k,
             probe_fused_q6k,
+            probe_fused_q8,
         )
 
         passed = set()
@@ -265,7 +266,8 @@ class Engine:
         for name, gtype, probe in (
                 ("Q4_K", GGMLType.Q4_K, probe_fused_q4k),
                 ("Q5_K", GGMLType.Q5_K, probe_fused_q5k),
-                ("Q6_K", GGMLType.Q6_K, probe_fused_q6k)):
+                ("Q6_K", GGMLType.Q6_K, probe_fused_q6k),
+                ("Q8_0", GGMLType.Q8_0, probe_fused_q8)):
             if present_types is not None and gtype not in present_types:
                 continue
             probed.add(gtype)
@@ -418,6 +420,8 @@ class Engine:
             # first token came out of prefill; the decode phase produced n-1
             "tokens_per_sec": (n - 1) / decode_s if n > 1 and decode_s > 0 else 0.0,
         }
+        if "spec" in ctx:      # speculative decode: acceptance telemetry
+            timings["spec"] = ctx["spec"]
         self._record_timings(timings)
         return timings
 
@@ -525,6 +529,12 @@ class Engine:
         pos = ctx["n_prompt"]
         D = self._spec_draft
         done = len(gen) >= budget
+        # acceptance telemetry → lfkt_timings["spec"] (scraped to /metrics):
+        # accepted/drafted is THE number that says whether speculation pays
+        # on this workload
+        stats = ctx.setdefault(
+            "spec", {"verify_steps": 0, "drafted": 0, "accepted": 0,
+                     "fallback_steps": 0})
         while not done:
             remaining = budget - len(gen)
             capacity = self.cfg.n_ctx - pos - 1   # cache slots left to write
@@ -537,6 +547,9 @@ class Engine:
                 cnt = int(cnt)                    # host sync
                 toks = np.asarray(toks)[:min(cnt, remaining)].tolist()
                 pos += cnt
+                stats["verify_steps"] += 1
+                stats["drafted"] += D
+                stats["accepted"] += cnt - 1      # beyond the always-free one
             else:
                 n = self._next_steps(len(gen), pos, budget)
                 if n <= 0:
@@ -545,6 +558,7 @@ class Engine:
                     ctx["state"], ctx["st"], n, ctx["sp"].top_k)
                 toks = np.asarray(t).tolist()
                 pos += n
+                stats["fallback_steps"] += 1
             for t in toks:
                 if t in stop_ids:
                     finish = "stop"

@@ -10,13 +10,14 @@ Formats (``ops.linear``):
   one v5e chip; use for small models and CPU tests.
 - ``int8`` — symmetric per-channel requant of the dequantized weights,
   1 B/weight (~8.5 GB for 8B incl. bf16 embeddings).
-- ``q4k`` — Q4_K tensors stay in (nearly) their GGUF bit layout in HBM
-  (~5 bit/weight) and are dequantized in-VMEM by the fused Pallas matmul
-  (ops/pallas/qmatmul.py); non-Q4_K tensors fall back to int8.  The v5e
-  serving format: lowest decode HBM traffic.  Because per-layer tensors are
-  stacked for ``lax.scan``, the format choice is made per tensor *name*:
-  a name uses q4k only if every layer's tensor of that name is Q4_K with
-  kernel-compatible shapes (Q4_K_M files mix in Q6_K for some layers).
+- ``q4k`` — fused serving: Q4_K / Q5_K / Q6_K / Q8_0 tensors stay in
+  (nearly) their GGUF bit layouts in HBM (~5 / 6 / 7 / 9 bit/weight) and
+  are dequantized in-VMEM by their fused Pallas matmuls (ops/pallas/
+  q*matmul.py); anything else falls back to int8.  The v5e serving
+  format: lowest decode HBM traffic at file fidelity.  Because per-layer
+  tensors are stacked for ``lax.scan``, the choice is per tensor *name*:
+  a name fuses only if every layer's tensor of that name shares one
+  eligible type (Q4_K_M files mix in Q6_K for some layers).
 
 GGUF tensor names follow llama.cpp's convention: ``token_embd.weight``,
 ``blk.{i}.attn_{q,k,v,output}.weight``, ``blk.{i}.ffn_{gate,up,down}.weight``,
@@ -73,7 +74,7 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     the numpy reference codecs.  Both produce identical pytrees.
 
     ``fused_types`` restricts which GGML types may use their fused kernel
-    under ``fmt="q4k"`` (default: Q4_K, Q5_K and Q6_K).  The engine passes
+    under ``fmt="q4k"`` (default: Q4_K, Q5_K, Q6_K and Q8_0).  The engine passes
     the set of types whose compile probes passed, so a Mosaic regression
     in ONE kernel degrades only that format's tensors to int8.
     """
@@ -84,14 +85,14 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
 
     def _fused_names() -> dict[str, object]:
         """Linear positions where ALL layers share one fused-kernel-eligible
-        quantized type (Q4_K, Q5_K or Q6_K — Q4_K_M/Q5_K_M files mix them;
+        quantized type (Q4_K/Q5_K/Q6_K/Q8_0 — Q4_K_M/Q5_K_M files mix them;
         a name whose layers mix types falls back to int8 because stacked
         scan params need one layout per name)."""
         from ..gguf.constants import GGMLType
         from ..ops.pallas.qmatmul import q4k_compatible
 
         fusable = tuple(fused_types) if fused_types is not None \
-            else (GGMLType.Q4_K, GGMLType.Q5_K, GGMLType.Q6_K)
+            else (GGMLType.Q4_K, GGMLType.Q5_K, GGMLType.Q6_K, GGMLType.Q8_0)
         names = ["attn_q", "attn_k", "attn_v", "attn_output",
                  "ffn_gate", "ffn_up", "ffn_down"]
         ok: dict[str, object] = {}
@@ -116,12 +117,14 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
             from ..gguf.constants import GGMLType
             from ..ops.pallas.q5matmul import prep_q5k
             from ..ops.pallas.q6matmul import prep_q6k
+            from ..ops.pallas.q8matmul import prep_q8_0
             from ..ops.pallas.qmatmul import prep_q4k
 
             t = gf[name]
             n_out, k_in = tuple(reversed(t.shape))
             prep = {GGMLType.Q4_K: prep_q4k, GGMLType.Q5_K: prep_q5k,
-                    GGMLType.Q6_K: prep_q6k}[fused_names[short]]
+                    GGMLType.Q6_K: prep_q6k,
+                    GGMLType.Q8_0: prep_q8_0}[fused_names[short]]
             return prep(np.asarray(t.raw()), n_out, k_in)
         if on_device:
             w = _tensor_to_device(gf[name])

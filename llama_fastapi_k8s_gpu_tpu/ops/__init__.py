@@ -6,4 +6,5 @@ from .linear import (  # noqa: F401
     make_linear_q4k,
     make_linear_q5k,
     make_linear_q6k,
+    make_linear_q8,
 )

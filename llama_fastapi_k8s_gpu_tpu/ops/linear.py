@@ -59,6 +59,18 @@ def make_linear_q4k(w: np.ndarray) -> dict:
     return prep_q4k(quant_q4_k(w.reshape(-1)), n_out, k_in)
 
 
+def make_linear_q8(w: np.ndarray) -> dict:
+    """(out, in) float weights → fused-kernel Q8_0 layout (quantize with the
+    in-tree codec, then pack for ops/pallas/q8matmul.py).  ~9 bit/weight on
+    the file's own per-32-block quantization grid (scales folded to bf16)."""
+    from ..gguf.quants import quant_q8_0
+    from .pallas.q8matmul import prep_q8_0
+
+    w = np.ascontiguousarray(w, dtype=np.float32)
+    n_out, k_in = w.shape
+    return prep_q8_0(quant_q8_0(w.reshape(-1)), n_out, k_in)
+
+
 def make_linear_q6k(w: np.ndarray) -> dict:
     """(out, in) float weights → fused-kernel Q6_K layout (quantize with the
     in-tree codec, then pack for ops/pallas/q6matmul.py).  ~7 bit/weight in
@@ -96,6 +108,10 @@ def linear(x: jax.Array, w: dict) -> jax.Array:
         from .pallas.q5matmul import q5k_matmul
 
         return q5k_matmul(x, w)
+    if "q8" in w:
+        from .pallas.q8matmul import q8_matmul
+
+        return q8_matmul(x, w)
     if "w" in w:
         return jax.lax.dot_general(
             x, w["w"],

@@ -45,6 +45,7 @@ from .dequant import (  # noqa: E402
 )
 from .q5matmul import prep_q5k, q5k_matmul  # noqa: E402
 from .q6matmul import prep_q6k, q6k_matmul  # noqa: E402
+from .q8matmul import prep_q8_0, q8_matmul  # noqa: E402
 from .qmatmul import prep_q4k, q4k_matmul  # noqa: E402
 
 __all__ = [
@@ -57,9 +58,11 @@ __all__ = [
     "prep_q4k",
     "prep_q5k",
     "prep_q6k",
+    "prep_q8_0",
     "q4k_matmul",
     "q5k_matmul",
     "q6k_matmul",
+    "q8_matmul",
     "force_interpret",
     "use_interpret",
 ]

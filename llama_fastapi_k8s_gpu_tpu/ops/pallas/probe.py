@@ -20,7 +20,7 @@ import functools
 import numpy as np
 
 __all__ = ["probe_fused_q4k", "probe_fused_q5k", "probe_fused_q6k",
-           "probe_flash_attention"]
+           "probe_fused_q8", "probe_flash_attention"]
 
 
 def _err(e: BaseException) -> str:
@@ -95,6 +95,27 @@ def probe_fused_q6k() -> str | None:
             rng.standard_normal(n * 2048).astype(np.float32) * 0.02),
             n, 2048)
         y = q6k_matmul(jnp.ones((1, 2048), jnp.bfloat16), w)
+        float(y.sum())
+        return None
+    except Exception as e:  # noqa: BLE001
+        return _err(e)
+
+
+@functools.lru_cache(maxsize=1)
+def probe_fused_q8() -> str | None:
+    """Compile + run the fused Q8_0 matmul at the serving tile geometry."""
+    try:
+        import jax.numpy as jnp
+
+        from ...gguf.quants import quant_q8_0
+        from .q8matmul import prep_q8_0, q8_matmul
+
+        rng = np.random.default_rng(0)
+        n = _probe_n()
+        w = prep_q8_0(quant_q8_0(
+            rng.standard_normal(n * 2048).astype(np.float32) * 0.02),
+            n, 2048)
+        y = q8_matmul(jnp.ones((1, 2048), jnp.bfloat16), w)
         float(y.sum())
         return None
     except Exception as e:  # noqa: BLE001

@@ -71,6 +71,8 @@ def _linear_sharding(mesh: Mesh, col_parallel: bool) -> dict:
         "q4": _ns(mesh, None, "tp", None),
         "q2": _ns(mesh, None, "tp", None),
         "sm6": _ns(mesh, None, None, "tp", None),
+        "q8": _ns(mesh, None, "tp", None),
+        "sm8": _ns(mesh, None, None, "tp", None),
     }
     if col_parallel:
         return {"w": _ns(mesh, None, "tp", None),
@@ -107,7 +109,9 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
             "q5s": _ns(mesh, "tp", None), "q5h": _ns(mesh, "tp", None),
             "sm5": _ns(mesh, None, "tp", None),
             "q4": _ns(mesh, "tp", None), "q2": _ns(mesh, "tp", None),
-            "sm6": _ns(mesh, None, "tp", None)}
+            "sm6": _ns(mesh, None, "tp", None),
+            "q8": _ns(mesh, "tp", None),
+            "sm8": _ns(mesh, None, "tp", None)}
     out_shard = {k: head[k] for k in out}
     return {
         "tok_emb": _ns(mesh, None, None),      # replicated (gather-heavy)
@@ -161,7 +165,7 @@ def _fit_sharding(arr, ns: NamedSharding) -> NamedSharding:
     return NamedSharding(mesh, P(*fixed))
 
 
-_FUSED_MAIN_KEY = {"qs": "qs", "q4": "q4", "q5s": "q5s"}  # layout → (…,N,K/x) leaf
+_FUSED_MAIN_KEY = {"qs": "qs", "q4": "q4", "q5s": "q5s", "q8": "q8"}  # layout → main leaf
 
 
 def _fused_key(p: dict) -> str | None:

@@ -214,6 +214,12 @@ def create_app(engine=None, settings: Settings | None = None,
             if timings["tokens_per_sec"]:
                 m.observe("engine_decode_tokens_per_sec",
                           timings["tokens_per_sec"])
+            spec = timings.get("spec")
+            if spec:   # speculative decode: acceptance is THE payoff number
+                m.inc("spec_drafted_tokens_total", spec["drafted"])
+                m.inc("spec_accepted_tokens_total", spec["accepted"])
+                m.inc("spec_verify_steps_total", spec["verify_steps"])
+                m.inc("spec_fallback_steps_total", spec["fallback_steps"])
 
     def _answer_to_text(answer, m) -> str:
         """OpenAI-shaped dict → concatenated choice text (reference

@@ -196,3 +196,12 @@ def test_lookup_draft_heuristic():
     assert d == [9, 9, 1, 2]
     assert Engine._lookup_draft([1, 2, 3, 4], 4) is None
     assert Engine._lookup_draft([5, 5], 3) == [5, 0, 0]
+
+
+def test_spec_timings_report_acceptance(tmp_path):
+    _, spec = _two_engines(tmp_path)
+    out = spec.create_chat_completion(MSGS, temperature=0.0, max_tokens=16,
+                                      seed=2)
+    st = out["lfkt_timings"]["spec"]
+    assert st["verify_steps"] + st["fallback_steps"] >= 1
+    assert 0 <= st["accepted"] <= st["drafted"]
