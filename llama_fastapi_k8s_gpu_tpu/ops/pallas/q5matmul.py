@@ -42,6 +42,7 @@ from .qmatmul import (
     _pick_tn,
     _spec_axis,
     augment_x,
+    batched_rows,
     permute_x,
     q4k_compatible,
 )
@@ -237,28 +238,12 @@ def _q5k_2d_partitioned(interpret: bool):
     return jax.jit(fn)
 
 
-_MAX_B5 = 128
-
-
 def q5k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:
     """x (..., K) bf16/f32 → (..., N) in x.dtype, weights in Q5_K kernel
     layout.  The fused path of ``ops.linear.linear`` for Q5_K tensors."""
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
-    itp = _interpret(interpret)
-    fn = _q5k_2d_partitioned(itp)
-    B = xpa.shape[0]
-    if B <= _MAX_B5:
-        y = fn(xpa, w["q5s"], w["q5h"], w["sm5"])
-    else:
-        pad = (-B) % _MAX_B5
-        if pad:
-            xpa = jnp.concatenate(
-                [xpa, jnp.zeros((pad, xpa.shape[1]), xpa.dtype)], axis=0)
-        chunks = [
-            fn(xpa[i:i + _MAX_B5], w["q5s"], w["q5h"], w["sm5"])
-            for i in range(0, B + pad, _MAX_B5)
-        ]
-        y = jnp.concatenate(chunks, axis=0)[:B]
+    fn = _q5k_2d_partitioned(_interpret(interpret))
+    y = batched_rows(fn, xpa, w["q5s"], w["q5h"], w["sm5"])
     return y.reshape(*lead, -1).astype(x.dtype)

@@ -49,7 +49,14 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
-from .qmatmul import TK, _interpret, _pick_tn, _spec_axis, q4k_compatible
+from .qmatmul import (
+    TK,
+    _interpret,
+    _pick_tn,
+    _spec_axis,
+    batched_rows,
+    q4k_compatible,
+)
 
 _SUBS6 = TK // 16    # 128 sub-blocks of 16 per k-tile
 TKA6 = TK + 256      # + [xsum_all(128) | xsum_hi(128)] correction columns
@@ -269,28 +276,12 @@ def _q6k_2d_partitioned(interpret: bool):
     return jax.jit(fn)
 
 
-_MAX_B6 = 128
-
-
 def q6k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:
     """x (..., K) bf16/f32 → (..., N) in x.dtype, weights in Q6_K kernel
     layout.  The fused path of ``ops.linear.linear`` for Q6_K tensors."""
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x6(permute_x6(x).reshape(-1, K).astype(jnp.bfloat16))
-    itp = _interpret(interpret)
-    fn = _q6k_2d_partitioned(itp)
-    B = xpa.shape[0]
-    if B <= _MAX_B6:
-        y = fn(xpa, w["q4"], w["q2"], w["sm6"])
-    else:
-        pad = (-B) % _MAX_B6
-        if pad:
-            xpa = jnp.concatenate(
-                [xpa, jnp.zeros((pad, xpa.shape[1]), xpa.dtype)], axis=0)
-        chunks = [
-            fn(xpa[i:i + _MAX_B6], w["q4"], w["q2"], w["sm6"])
-            for i in range(0, B + pad, _MAX_B6)
-        ]
-        y = jnp.concatenate(chunks, axis=0)[:B]
+    fn = _q6k_2d_partitioned(_interpret(interpret))
+    y = batched_rows(fn, xpa, w["q4"], w["q2"], w["sm6"])
     return y.reshape(*lead, -1).astype(x.dtype)

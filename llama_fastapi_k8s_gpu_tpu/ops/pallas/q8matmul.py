@@ -35,7 +35,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType
-from .qmatmul import TK, _interpret, _pick_tn, _spec_axis, permute_x, q4k_compatible
+from .qmatmul import (
+    TK,
+    _interpret,
+    _pick_tn,
+    _spec_axis,
+    batched_rows,
+    permute_x,
+    q4k_compatible,
+)
 
 q8_compatible = q4k_compatible  # same divisibility classes
 
@@ -155,28 +163,12 @@ def _q8_2d_partitioned(interpret: bool):
     return jax.jit(fn)
 
 
-_MAX_B8 = 128
-
-
 def q8_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:
     """x (..., K) bf16/f32 → (..., N) in x.dtype, weights in Q8_0 kernel
     layout.  The fused path of ``ops.linear.linear`` for Q8_0 tensors."""
     K = x.shape[-1]
     lead = x.shape[:-1]
     xp = permute_x(x).reshape(-1, K).astype(jnp.bfloat16)
-    itp = _interpret(interpret)
-    fn = _q8_2d_partitioned(itp)
-    B = xp.shape[0]
-    if B <= _MAX_B8:
-        y = fn(xp, w["q8"], w["sm8"])
-    else:
-        pad = (-B) % _MAX_B8
-        if pad:
-            xp = jnp.concatenate(
-                [xp, jnp.zeros((pad, K), xp.dtype)], axis=0)
-        chunks = [
-            fn(xp[i:i + _MAX_B8], w["q8"], w["sm8"])
-            for i in range(0, B + pad, _MAX_B8)
-        ]
-        y = jnp.concatenate(chunks, axis=0)[:B]
+    fn = _q8_2d_partitioned(_interpret(interpret))
+    y = batched_rows(fn, xp, w["q8"], w["sm8"])
     return y.reshape(*lead, -1).astype(x.dtype)

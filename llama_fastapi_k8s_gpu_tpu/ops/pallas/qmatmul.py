@@ -314,32 +314,36 @@ def _q4k_2d_partitioned(interpret: bool):
 
 _MAX_B = 128  # rows per kernel call: bounds the xpa/out VMEM blocks (the
               # weight-tile intermediates dominate at ~10 MB of the ~16 MB
-              # VMEM with TN=512, so the activation side stays small)
+              # VMEM with TN=512, so the activation side stays small).
+              # Shared by every fused kernel via batched_rows().
+
+
+def batched_rows(fn, xpa: jax.Array, *weights) -> jax.Array:
+    """Run a fused 2D matmul over ``xpa`` (B, K') in row chunks of
+    ``_MAX_B`` so the activation/output VMEM blocks stay bounded for large
+    batch/sequence dims (prefill buckets).  Shared by all fused kernels
+    (Q4_K / Q5_K / Q6_K / Q8_0) — one place to tune the row bound."""
+    B = xpa.shape[0]
+    if B <= _MAX_B:
+        return fn(xpa, *weights)
+    pad = (-B) % _MAX_B
+    if pad:
+        xpa = jnp.concatenate(
+            [xpa, jnp.zeros((pad, xpa.shape[1]), xpa.dtype)], axis=0)
+    chunks = [
+        fn(xpa[i:i + _MAX_B], *weights)
+        for i in range(0, B + pad, _MAX_B)
+    ]
+    return jnp.concatenate(chunks, axis=0)[:B]
 
 
 def q4k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:
     """x (..., K) bf16/f32 → (..., N) in x.dtype, weights in Q4_K kernel
-    layout (see module docstring).  The fused path of ``ops.linear.linear``.
-
-    Large batch/sequence dims (prefill buckets) are processed in row chunks
-    of ``_MAX_B`` so VMEM blocks stay bounded."""
+    layout (see module docstring).  The fused path of ``ops.linear.linear``."""
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x(
         permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
-    itp = _interpret(interpret)
-    fn = _q4k_2d_partitioned(itp)
-    B = xpa.shape[0]
-    if B <= _MAX_B:
-        y = fn(xpa, w["qs"], w["sm"])
-    else:
-        pad = (-B) % _MAX_B
-        if pad:
-            xpa = jnp.concatenate(
-                [xpa, jnp.zeros((pad, xpa.shape[1]), xpa.dtype)], axis=0)
-        chunks = [
-            fn(xpa[i:i + _MAX_B], w["qs"], w["sm"])
-            for i in range(0, B + pad, _MAX_B)
-        ]
-        y = jnp.concatenate(chunks, axis=0)[:B]
+    fn = _q4k_2d_partitioned(_interpret(interpret))
+    y = batched_rows(fn, xpa, w["qs"], w["sm"])
     return y.reshape(*lead, -1).astype(x.dtype)

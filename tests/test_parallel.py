@@ -94,18 +94,19 @@ def test_dp_tp_batched_serving_step(params, mesh):
 # match the unsharded result (custom_partitioning in ops/pallas/q*matmul.py)
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("maker_name", ["q4k", "q5k", "q6k"])
+@pytest.mark.parametrize("maker_name", ["q4k", "q5k", "q6k", "q8"])
 def test_fused_matmul_partitioned_matches_unsharded(maker_name):
     from llama_fastapi_k8s_gpu_tpu.ops import (
         make_linear_q4k,
         make_linear_q5k,
         make_linear_q6k,
+        make_linear_q8,
     )
     from llama_fastapi_k8s_gpu_tpu.ops.linear import linear
     from llama_fastapi_k8s_gpu_tpu.parallel.mesh import shard_fused_linear
 
     maker = {"q4k": make_linear_q4k, "q5k": make_linear_q5k,
-             "q6k": make_linear_q6k}[maker_name]
+             "q6k": make_linear_q6k, "q8": make_linear_q8}[maker_name]
     rng = np.random.default_rng(5)
     wf = rng.standard_normal((256, 2048)).astype(np.float32) * 2048 ** -0.5
     w = maker(wf)
