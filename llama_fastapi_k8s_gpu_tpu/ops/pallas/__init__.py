@@ -43,10 +43,10 @@ from .dequant import (  # noqa: E402
     dequant_q6_k_device,
     dequant_q8_0_device,
 )
-from .q5matmul import prep_q5k, q5k_matmul  # noqa: E402
-from .q6matmul import prep_q6k, q6k_matmul  # noqa: E402
-from .q8matmul import prep_q8_0, q8_matmul  # noqa: E402
-from .qmatmul import prep_q4k, q4k_matmul  # noqa: E402
+from .q5matmul import prep_q5k, q5k_matmul, q5k_matmul_stacked  # noqa: E402
+from .q6matmul import prep_q6k, q6k_matmul, q6k_matmul_stacked  # noqa: E402
+from .q8matmul import prep_q8_0, q8_matmul, q8_matmul_stacked  # noqa: E402
+from .qmatmul import prep_q4k, q4k_matmul, q4k_matmul_stacked  # noqa: E402
 
 __all__ = [
     "flash_attention",
@@ -60,9 +60,13 @@ __all__ = [
     "prep_q6k",
     "prep_q8_0",
     "q4k_matmul",
+    "q4k_matmul_stacked",
     "q5k_matmul",
+    "q5k_matmul_stacked",
     "q6k_matmul",
+    "q6k_matmul_stacked",
     "q8_matmul",
+    "q8_matmul_stacked",
     "force_interpret",
     "use_interpret",
 ]

@@ -43,7 +43,7 @@ def probe_fused_q4k() -> str | None:
     try:
         import jax.numpy as jnp
 
-        from .qmatmul import prep_q4k, q4k_matmul
+        from .qmatmul import prep_q4k, q4k_matmul, q4k_matmul_stacked
 
         rng = np.random.default_rng(0)
         from ...gguf.quants import quant_q4_k
@@ -52,8 +52,12 @@ def probe_fused_q4k() -> str | None:
         w = prep_q4k(quant_q4_k(
             rng.standard_normal(n * 2048).astype(np.float32) * 0.02),
             n, 2048)
-        y = q4k_matmul(jnp.ones((1, 2048), jnp.bfloat16), w)
+        x = jnp.ones((1, 2048), jnp.bfloat16)
+        y = q4k_matmul(x, w)          # unstacked: the output head's path
         float(y.sum())   # host fetch: the only reliable sync on the tunnel
+        # stacked scalar-prefetch variant: the per-layer serving path
+        ws = {k: jnp.stack([v, v]) for k, v in w.items()}
+        float(q4k_matmul_stacked(x, ws, 1).sum())
         return None
     except Exception as e:  # noqa: BLE001 — any failure means "don't use it"
         return _err(e)
@@ -66,15 +70,17 @@ def probe_fused_q5k() -> str | None:
         import jax.numpy as jnp
 
         from ...gguf.quants import quant_q5_k
-        from .q5matmul import prep_q5k, q5k_matmul
+        from .q5matmul import prep_q5k, q5k_matmul, q5k_matmul_stacked
 
         rng = np.random.default_rng(0)
         n = _probe_n()
         w = prep_q5k(quant_q5_k(
             rng.standard_normal(n * 2048).astype(np.float32) * 0.02),
             n, 2048)
-        y = q5k_matmul(jnp.ones((1, 2048), jnp.bfloat16), w)
-        float(y.sum())
+        x = jnp.ones((1, 2048), jnp.bfloat16)
+        float(q5k_matmul(x, w).sum())
+        ws = {k: jnp.stack([v, v]) for k, v in w.items()}
+        float(q5k_matmul_stacked(x, ws, 1).sum())
         return None
     except Exception as e:  # noqa: BLE001
         return _err(e)
@@ -87,15 +93,17 @@ def probe_fused_q6k() -> str | None:
         import jax.numpy as jnp
 
         from ...gguf.quants import quant_q6_k
-        from .q6matmul import prep_q6k, q6k_matmul
+        from .q6matmul import prep_q6k, q6k_matmul, q6k_matmul_stacked
 
         rng = np.random.default_rng(0)
         n = _probe_n()
         w = prep_q6k(quant_q6_k(
             rng.standard_normal(n * 2048).astype(np.float32) * 0.02),
             n, 2048)
-        y = q6k_matmul(jnp.ones((1, 2048), jnp.bfloat16), w)
-        float(y.sum())
+        x = jnp.ones((1, 2048), jnp.bfloat16)
+        float(q6k_matmul(x, w).sum())
+        ws = {k: jnp.stack([v, v]) for k, v in w.items()}
+        float(q6k_matmul_stacked(x, ws, 1).sum())
         return None
     except Exception as e:  # noqa: BLE001
         return _err(e)
@@ -108,15 +116,17 @@ def probe_fused_q8() -> str | None:
         import jax.numpy as jnp
 
         from ...gguf.quants import quant_q8_0
-        from .q8matmul import prep_q8_0, q8_matmul
+        from .q8matmul import prep_q8_0, q8_matmul, q8_matmul_stacked
 
         rng = np.random.default_rng(0)
         n = _probe_n()
         w = prep_q8_0(quant_q8_0(
             rng.standard_normal(n * 2048).astype(np.float32) * 0.02),
             n, 2048)
-        y = q8_matmul(jnp.ones((1, 2048), jnp.bfloat16), w)
-        float(y.sum())
+        x = jnp.ones((1, 2048), jnp.bfloat16)
+        float(q8_matmul(x, w).sum())
+        ws = {k: jnp.stack([v, v]) for k, v in w.items()}
+        float(q8_matmul_stacked(x, ws, 1).sum())
         return None
     except Exception as e:  # noqa: BLE001
         return _err(e)

@@ -45,6 +45,8 @@ from .qmatmul import (
     batched_rows,
     permute_x,
     q4k_compatible,
+    stacked_pallas_call,
+    stacked_partitioned,
 )
 
 q5k_compatible = q4k_compatible  # same divisibility classes
@@ -236,6 +238,50 @@ def _q5k_2d_partitioned(interpret: bool):
         sharding_rule="b k, n j, n p, t n l -> b n",
     )
     return jax.jit(fn)
+
+
+def _q5k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q5s: jax.Array,
+                        q5h: jax.Array, sm: jax.Array,
+                        interpret: bool) -> jax.Array:
+    B, KA = xpa.shape
+    K = (KA // TKA) * TK
+    N = q5s.shape[1]
+    TN = _pick_tn(N, interpret, prefs=(256, 128))
+    call = stacked_pallas_call(
+        functools.partial(_q5k_matmul_kernel, interpret=interpret),
+        grid=(N // TN, K // TK),
+        in_specs=[
+            ((B, TKA), lambda n, k: (0, k)),
+            ((TN, TK // 2), lambda n, k: (n, k)),
+            ((TN, TK // 8), lambda n, k: (n, k)),
+            ((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        out_spec=((B, TN), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
+        interpret=interpret,
+    )
+    return call(idx, xpa, q5s, q5h, sm)
+
+
+@functools.lru_cache(maxsize=4)
+def _q5k_2d_stacked_partitioned(interpret: bool):
+    return stacked_partitioned(
+        _q5k_2d_stacked_raw, "i, b k, l n j, l n p, l t n m -> b n",
+        interpret)
+
+
+def q5k_matmul_stacked(x: jax.Array, w: dict, idx,
+                       interpret: bool | None = None) -> jax.Array:
+    """x (..., K) → (..., N) against layer ``idx`` of stacked Q5_K weights
+    (``q5s`` (L, N, K/2), ``q5h`` (L, N, K/8), ``sm5`` (L, K/2048, N, 128))."""
+    K = x.shape[-1]
+    lead = x.shape[:-1]
+    xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
+    fn = _q5k_2d_stacked_partitioned(_interpret(interpret))
+    i1 = jnp.asarray(idx, jnp.int32).reshape(1)
+    y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws),
+                     xpa, w["q5s"], w["q5h"], w["sm5"])
+    return y.reshape(*lead, -1).astype(x.dtype)
 
 
 def q5k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:

@@ -56,6 +56,8 @@ from .qmatmul import (
     _spec_axis,
     batched_rows,
     q4k_compatible,
+    stacked_pallas_call,
+    stacked_partitioned,
 )
 
 _SUBS6 = TK // 16    # 128 sub-blocks of 16 per k-tile
@@ -274,6 +276,50 @@ def _q6k_2d_partitioned(interpret: bool):
         sharding_rule="b k, n j, n p, t n l -> b n",
     )
     return jax.jit(fn)
+
+
+def _q6k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q4: jax.Array,
+                        q2: jax.Array, sm: jax.Array,
+                        interpret: bool) -> jax.Array:
+    B, KA = xpa.shape
+    K = (KA // TKA6) * TK
+    N = q4.shape[1]
+    TN = _pick_tn(N, interpret, prefs=(256, 128))
+    call = stacked_pallas_call(
+        functools.partial(_q6k_matmul_kernel, interpret=interpret),
+        grid=(N // TN, K // TK),
+        in_specs=[
+            ((B, TKA6), lambda n, k: (0, k)),
+            ((TN, TK // 2), lambda n, k: (n, k)),
+            ((TN, TK // 4), lambda n, k: (n, k)),
+            ((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        out_spec=((B, TN), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
+        interpret=interpret,
+    )
+    return call(idx, xpa, q4, q2, sm)
+
+
+@functools.lru_cache(maxsize=4)
+def _q6k_2d_stacked_partitioned(interpret: bool):
+    return stacked_partitioned(
+        _q6k_2d_stacked_raw, "i, b k, l n j, l n p, l t n m -> b n",
+        interpret)
+
+
+def q6k_matmul_stacked(x: jax.Array, w: dict, idx,
+                       interpret: bool | None = None) -> jax.Array:
+    """x (..., K) → (..., N) against layer ``idx`` of stacked Q6_K weights
+    (``q4`` (L, N, K/2), ``q2`` (L, N, K/4), ``sm6`` (L, K/2048, N, 128))."""
+    K = x.shape[-1]
+    lead = x.shape[:-1]
+    xpa = augment_x6(permute_x6(x).reshape(-1, K).astype(jnp.bfloat16))
+    fn = _q6k_2d_stacked_partitioned(_interpret(interpret))
+    i1 = jnp.asarray(idx, jnp.int32).reshape(1)
+    y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws),
+                     xpa, w["q4"], w["q2"], w["sm6"])
+    return y.reshape(*lead, -1).astype(x.dtype)
 
 
 def q6k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:

@@ -43,6 +43,8 @@ from .qmatmul import (
     batched_rows,
     permute_x,
     q4k_compatible,
+    stacked_pallas_call,
+    stacked_partitioned,
 )
 
 q8_compatible = q4k_compatible  # same divisibility classes
@@ -161,6 +163,45 @@ def _q8_2d_partitioned(interpret: bool):
         sharding_rule="b k, n j, t n l -> b n",
     )
     return jax.jit(fn)
+
+
+def _q8_2d_stacked_raw(idx: jax.Array, xp: jax.Array, q8: jax.Array,
+                       sm: jax.Array, interpret: bool) -> jax.Array:
+    B, K = xp.shape
+    N = q8.shape[1]
+    TN = _pick_tn(N, interpret, prefs=(256, 128))
+    call = stacked_pallas_call(
+        functools.partial(_q8_matmul_kernel, interpret=interpret),
+        grid=(N // TN, K // TK),
+        in_specs=[
+            ((B, TK), lambda n, k: (0, k)),
+            ((TN, TK), lambda n, k: (n, k)),
+            ((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        out_spec=((B, TN), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
+        interpret=interpret,
+    )
+    return call(idx, xp, q8, sm)
+
+
+@functools.lru_cache(maxsize=4)
+def _q8_2d_stacked_partitioned(interpret: bool):
+    return stacked_partitioned(
+        _q8_2d_stacked_raw, "i, b k, l n j, l t n m -> b n", interpret)
+
+
+def q8_matmul_stacked(x: jax.Array, w: dict, idx,
+                      interpret: bool | None = None) -> jax.Array:
+    """x (..., K) → (..., N) against layer ``idx`` of stacked Q8_0 weights
+    (``q8`` (L, N, K), ``sm8`` (L, K/2048, N, 128))."""
+    K = x.shape[-1]
+    lead = x.shape[:-1]
+    xp = permute_x(x).reshape(-1, K).astype(jnp.bfloat16)
+    fn = _q8_2d_stacked_partitioned(_interpret(interpret))
+    i1 = jnp.asarray(idx, jnp.int32).reshape(1)
+    y = batched_rows(lambda xq, *ws: fn(i1, xq, *ws), xp, w["q8"], w["sm8"])
+    return y.reshape(*lead, -1).astype(x.dtype)
 
 
 def q8_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:

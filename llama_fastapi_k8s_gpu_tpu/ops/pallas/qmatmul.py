@@ -312,6 +312,165 @@ def _q4k_2d_partitioned(interpret: bool):
     return jax.jit(fn)
 
 
+# ---------------------------------------------------------------------------
+# stacked (per-layer) variants: scalar-prefetch layer indexing
+# ---------------------------------------------------------------------------
+#
+# The model iterates its layers with ``lax.scan`` over weights stacked as
+# (L, ...) arrays (models/llama.py).  A pallas_call operand must be a
+# materialized buffer, so scanning the weights as xs makes XLA *copy* each
+# layer's quantized planes (read+write of the full layer, ~137 MB for 8B
+# Q4_K) before every kernel call — measured +6.3 ms/token on v5e, turning
+# the fused win into a loss (tools/decode_breakdown.py).  The int8 path
+# doesn't pay this because XLA fuses the dynamic-slice into the dot_general
+# read.  The fix is TPU-idiomatic scalar prefetch: the layer index rides a
+# prefetched scalar and the BlockSpec index_maps address layer ``idx[0]``
+# of the stacked array directly, so block DMAs stream from the weights'
+# home HBM with no intermediate copy — and the model keeps one compiled
+# layer body (compile time ∝ 1, not n_layers).
+
+
+class _NoLead:
+    """Ref adapter hiding the leading length-1 layer axis of a stacked
+    weight block, so the unstacked kernel bodies run unchanged (they only
+    use ``ref.shape`` and ``ref[...]``)."""
+
+    __slots__ = ("_ref",)
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    @property
+    def shape(self):
+        return self._ref.shape[1:]
+
+    def __getitem__(self, idx):
+        return self._ref[idx].reshape(self._ref.shape[1:])
+
+
+def stacked_pallas_call(kernel, grid, in_specs, out_spec, out_shape,
+                        interpret: bool):
+    """Build ``fn(idx, xpa, *stacked_planes)`` running ``kernel`` (an
+    unstacked fused kernel ``(xpa_ref, *plane_refs, o_ref)``) against layer
+    ``idx[0]`` of weight planes stacked as (L, ...) arrays.
+
+    ``in_specs`` are the UNSTACKED (block_shape, index_map) pairs — first
+    the activations, then the weight planes; weight specs get the layer dim
+    prepended and their index_maps extended with the prefetched scalar.
+    Interpret mode (CPU tests) runs the same code path — pallas emulates
+    scalar prefetch."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    (x_block, x_map), *w_specs = in_specs
+
+    def lift(block, imap):
+        return pl.BlockSpec(
+            (1, *block), lambda *a, _m=imap: (a[-1][0], *_m(*a[:-1])))
+
+    specs = [pl.BlockSpec(x_block, lambda *a, _m=x_map: _m(*a[:-1]))]
+    specs += [lift(b, m) for b, m in w_specs]
+    o_block, o_map = out_spec
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=specs,
+        out_specs=pl.BlockSpec(o_block, lambda *a, _m=o_map: _m(*a[:-1])),
+    )
+
+    def wrapped(idx_ref, xpa_ref, *rest):
+        del idx_ref  # consumed by the index_maps
+        kernel(xpa_ref, *(_NoLead(r) for r in rest[:-1]), rest[-1])
+
+    return pl.pallas_call(
+        wrapped, grid_spec=gs, out_shape=out_shape, interpret=interpret)
+
+
+def _q4k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, qs: jax.Array,
+                        sm: jax.Array, interpret: bool) -> jax.Array:
+    B, KA = xpa.shape
+    K = (KA // TKA) * TK
+    N = qs.shape[1]
+    TN = _pick_tn(N, interpret)
+    call = stacked_pallas_call(
+        functools.partial(_q4k_matmul_kernel, interpret=interpret),
+        grid=(N // TN, K // TK),
+        in_specs=[
+            ((B, TKA), lambda n, k: (0, k)),
+            ((TN, TK // 2), lambda n, k: (n, k)),
+            ((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        out_spec=((B, TN), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
+        interpret=interpret,
+    )
+    return call(idx, xpa, qs, sm)
+
+
+def stacked_partitioned(raw_fn, sharding_rule: str, interpret: bool):
+    """GSPMD rule shared by every stacked fused matmul — same contract as
+    the unstacked kernels (partition over N and rows, never K) plus: the
+    layer dim and the index scalar are never split.
+
+    ``raw_fn(idx, xpa, *planes, interpret=...)`` is the stacked pallas
+    call; plane shardings are derived from rank (value planes (L, N, K/x),
+    scale planes (L, kt, N, 128) — N is always at ``rank - 2``)."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @custom_partitioning
+    def fn(idx, xpa, *planes):
+        return raw_fn(idx, xpa, *planes, interpret=interpret)
+
+    def lower(idx, xpa, *planes):
+        return raw_fn(idx, xpa, *planes, interpret=interpret)
+
+    def partition(mesh, arg_shapes, result_shape):
+        rows = _spec_axis(arg_shapes[1].sharding, 0)
+        n_ax = _spec_axis(arg_shapes[2].sharding, 1)
+        arg_shardings = [
+            NamedSharding(mesh, P(None)),
+            NamedSharding(mesh, P(rows, None)),
+        ] + [
+            NamedSharding(
+                mesh, P(*([None] * (len(a.shape) - 2)), n_ax, None))
+            for a in arg_shapes[2:]
+        ]
+        return (mesh, lower, NamedSharding(mesh, P(rows, n_ax)),
+                tuple(arg_shardings))
+
+    def infer(mesh, arg_shapes, result_shape):
+        return NamedSharding(
+            mesh, P(_spec_axis(arg_shapes[1].sharding, 0),
+                    _spec_axis(arg_shapes[2].sharding, 1)))
+
+    fn.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule=sharding_rule,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=4)
+def _q4k_2d_stacked_partitioned(interpret: bool):
+    return stacked_partitioned(
+        _q4k_2d_stacked_raw, "i, b k, l n j, l t n m -> b n", interpret)
+
+
+def q4k_matmul_stacked(x: jax.Array, w: dict, idx,
+                       interpret: bool | None = None) -> jax.Array:
+    """x (..., K) → (..., N) against layer ``idx`` of stacked weights
+    (``qs`` (L, N, K/2), ``sm`` (L, K/2048, N, 128)).  The fused path of
+    ``ops.linear.linear_at`` — no per-layer weight copy under scan."""
+    K = x.shape[-1]
+    lead = x.shape[:-1]
+    xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
+    fn = _q4k_2d_stacked_partitioned(_interpret(interpret))
+    i1 = jnp.asarray(idx, jnp.int32).reshape(1)
+    y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws), xpa, w["qs"], w["sm"])
+    return y.reshape(*lead, -1).astype(x.dtype)
+
+
 _MAX_B = 128  # rows per kernel call: bounds the xpa/out VMEM blocks (the
               # weight-tile intermediates dominate at ~10 MB of the ~16 MB
               # VMEM with TN=512, so the activation side stays small).
