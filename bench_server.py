@@ -91,6 +91,11 @@ def main() -> None:
     eng = Engine.from_parts(params, cfg, tok, template_kind="llama3",
                             max_gen_tokens=max_tokens,
                             attn_impl=cfg.attn_impl)
+    # compile every shape BEFORE the server phase, exactly like the
+    # production factory (server/app.py calls eng.warmup() at startup);
+    # without it the first request compiles for ~60 s and the 25 s
+    # admission timeout 408s it, killing the warmup POST below
+    eng.warmup()
     app = create_app(engine=eng)
 
     th = threading.Thread(

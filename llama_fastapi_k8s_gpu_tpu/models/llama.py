@@ -55,14 +55,20 @@ def init_cache(cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _layer(h, layers, i, ck, cv, positions, pos_offset, cfg: ModelConfig):
+def _layer(h, layers, i, ck_all, cv_all, positions, pos_offset,
+           cfg: ModelConfig):
     """One transformer block over S tokens against layer ``i`` of the
-    stacked weights. ck/cv: (n_ctx, n_kv, hd).
+    stacked weights. ck_all/cv_all: the FULL stacked cache
+    (L, n_ctx, n_kv, hd).
 
     The weights stay STACKED (L, ...) and are addressed per layer with
     :func:`ops.linear.linear_at` — scanning them as xs would materialize a
     per-layer copy of every fused quantized plane before its pallas_call
-    (+6.3 ms/token measured on 8B v5e decode, tools/decode_breakdown.py)."""
+    (+6.3 ms/token measured on 8B v5e decode, tools/decode_breakdown.py).
+    The cache is updated the same way: only the S new token slots of layer
+    ``i`` are written (``dynamic_update_slice`` at (i, pos, 0, 0)); carrying
+    per-layer caches through ``lax.scan`` xs/ys instead restacks the whole
+    ring every step — ~256 MB/token at n_ctx 1024, ~2 GB at 8192."""
     S = h.shape[0]
     n_kv, group, hd = cfg.n_kv_heads, cfg.n_heads // cfg.n_kv_heads, cfg.head_dim
 
@@ -76,8 +82,12 @@ def _layer(h, layers, i, ck, cv, positions, pos_offset, cfg: ModelConfig):
     q = rope_interleaved(q, positions, cfg.rope_theta)
     k = rope_interleaved(k, positions, cfg.rope_theta)
 
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (pos_offset, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (pos_offset, 0, 0))
+    ck_all = jax.lax.dynamic_update_slice(
+        ck_all, k.astype(ck_all.dtype)[None], (i, pos_offset, 0, 0))
+    cv_all = jax.lax.dynamic_update_slice(
+        cv_all, v.astype(cv_all.dtype)[None], (i, pos_offset, 0, 0))
+    ck = jax.lax.dynamic_index_in_dim(ck_all, i, axis=0, keepdims=False)
+    cv = jax.lax.dynamic_index_in_dim(cv_all, i, axis=0, keepdims=False)
 
     if cfg.attn_impl == "ring":
         # sequence-parallel: KV sharded over the sp mesh axis (parallel/ring.py)
@@ -122,7 +132,7 @@ def _layer(h, layers, i, ck, cv, positions, pos_offset, cfg: ModelConfig):
     hn = rms_norm(h, layers["ffn_norm"][i], cfg.rms_eps)
     gated = jax.nn.silu(lin(hn, "w_gate").astype(jnp.float32)).astype(h.dtype)
     h = h + lin(gated * lin(hn, "w_up"), "w_down")
-    return h, ck, cv
+    return h, ck_all, cv_all
 
 
 def forward(
@@ -141,22 +151,28 @@ def forward(
     h = jnp.take(params["tok_emb"], tokens, axis=0).astype(jnp.bfloat16)
     positions = pos_offset + jnp.arange(S, dtype=jnp.int32)
 
-    def step(carry, xs):
-        i, ck, cv = xs
-        hh, ck, cv = _layer(carry, params["layers"], i, ck, cv, positions,
-                            pos_offset, cfg)
-        return hh, (ck, cv)
+    # trace-time layer-count check over EVERY stacked leaf: looping over ids
+    # (not weight xs) would otherwise let a config/checkpoint depth mismatch
+    # silently clamp the per-layer gathers to the last real layer (scan over
+    # xs used to enforce this shape agreement implicitly)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            params["layers"])[0]:
+        if leaf.shape[0] != cfg.n_layers:
+            name = jax.tree_util.keystr(path)
+            raise ValueError(
+                f"stacked leaf {name} has {leaf.shape[0]} layers but "
+                f"cfg.n_layers={cfg.n_layers}")
 
-    # trace-time layer-count check: scanning over ids (not weight xs) would
-    # otherwise let a config/checkpoint depth mismatch silently clamp the
-    # per-layer gathers to the last real layer instead of erroring
-    L = params["layers"]["attn_norm"].shape[0]
-    if L != cfg.n_layers:
-        raise ValueError(
-            f"params have {L} stacked layers but cfg.n_layers={cfg.n_layers}")
-    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    h, (new_k, new_v) = jax.lax.scan(
-        step, h, (layer_ids, cache["k"], cache["v"]))
+    # fori_loop (not scan with cache xs/ys): the stacked cache rides the
+    # carry and each layer writes only its S new token slots in place —
+    # scan's ys-restack rewrites the entire ring every call (~256 MB/token
+    # at n_ctx 1024, ~2 GB at 8192 — measured as most of the 8k decode gap)
+    def body(i, carry):
+        return _layer(carry[0], params["layers"], jnp.int32(i), carry[1],
+                      carry[2], positions, pos_offset, cfg)
+
+    h, new_k, new_v = jax.lax.fori_loop(
+        0, cfg.n_layers, body, (h, cache["k"], cache["v"]))
     new_cache = {"k": new_k, "v": new_v}
 
     out_w = params["output"]

@@ -45,6 +45,7 @@ from .qmatmul import (
     batched_rows,
     permute_x,
     q4k_compatible,
+    plain_pallas_call,
     stacked_pallas_call,
     stacked_partitioned,
 )
@@ -177,25 +178,34 @@ def _q5k_matmul_kernel(xpa_ref, q5s_ref, q5h_ref, sm_ref, o_ref, *, interpret):
     o_ref[...] += part
 
 
+_TN_PREFS_Q5K = (256, 128)
+
+
+def _q5k_specs(B: int, TN: int):
+    """Single tiling definition for both the unstacked and stacked calls
+    (see qmatmul._q4k_specs)."""
+    return (
+        [
+            ((B, TKA), lambda n, k: (0, k)),
+            ((TN, TK // 2), lambda n, k: (n, k)),
+            ((TN, TK // 8), lambda n, k: (n, k)),
+            ((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        ((B, TN), lambda n, k: (0, n)),
+    )
+
+
 def _q5k_2d_raw(xpa: jax.Array, q5s: jax.Array, q5h: jax.Array,
                 sm: jax.Array, interpret: bool) -> jax.Array:
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = q5s.shape[0]
-    TN = _pick_tn(N, interpret, prefs=(256, 128))
-    grid = (N // TN, K // TK)
-    return pl.pallas_call(
+    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q5K)
+    in_specs, out_spec = _q5k_specs(B, TN)
+    return plain_pallas_call(
         functools.partial(_q5k_matmul_kernel, interpret=interpret),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((B, TKA), lambda n, k: (0, k)),
-            pl.BlockSpec((TN, TK // 2), lambda n, k: (n, k)),
-            pl.BlockSpec((TN, TK // 8), lambda n, k: (n, k)),
-            pl.BlockSpec((1, TN, 128), lambda n, k: (k, n, 0)),
-        ],
-        out_specs=pl.BlockSpec((B, TN), lambda n, k: (0, n)),
-        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
-        interpret=interpret,
+        (N // TN, K // TK), in_specs, out_spec,
+        jax.ShapeDtypeStruct((B, N), jnp.float32), interpret,
     )(xpa, q5s, q5h, sm)
 
 
@@ -246,17 +256,13 @@ def _q5k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q5s: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = q5s.shape[1]
-    TN = _pick_tn(N, interpret, prefs=(256, 128))
+    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q5K)
+    in_specs, out_spec = _q5k_specs(B, TN)
     call = stacked_pallas_call(
         functools.partial(_q5k_matmul_kernel, interpret=interpret),
         grid=(N // TN, K // TK),
-        in_specs=[
-            ((B, TKA), lambda n, k: (0, k)),
-            ((TN, TK // 2), lambda n, k: (n, k)),
-            ((TN, TK // 8), lambda n, k: (n, k)),
-            ((1, TN, 128), lambda n, k: (k, n, 0)),
-        ],
-        out_spec=((B, TN), lambda n, k: (0, n)),
+        in_specs=in_specs,
+        out_spec=out_spec,
         out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
         interpret=interpret,
     )

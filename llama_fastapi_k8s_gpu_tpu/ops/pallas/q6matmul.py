@@ -56,6 +56,7 @@ from .qmatmul import (
     _spec_axis,
     batched_rows,
     q4k_compatible,
+    plain_pallas_call,
     stacked_pallas_call,
     stacked_partitioned,
 )
@@ -215,25 +216,34 @@ def _q6k_matmul_kernel(xpa_ref, q4_ref, q2_ref, sm_ref, o_ref, *, interpret):
     o_ref[...] += part
 
 
+_TN_PREFS_Q6K = (256, 128)  # wider f32 intermediates than Q4_K: smaller TN
+
+
+def _q6k_specs(B: int, TN: int):
+    """Single tiling definition for both the unstacked and stacked calls
+    (see qmatmul._q4k_specs)."""
+    return (
+        [
+            ((B, TKA6), lambda n, k: (0, k)),
+            ((TN, TK // 2), lambda n, k: (n, k)),
+            ((TN, TK // 4), lambda n, k: (n, k)),
+            ((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        ((B, TN), lambda n, k: (0, n)),
+    )
+
+
 def _q6k_2d_raw(xpa: jax.Array, q4: jax.Array, q2: jax.Array, sm: jax.Array,
                 interpret: bool) -> jax.Array:
     B, KA = xpa.shape
     K = (KA // TKA6) * TK
     N = q4.shape[0]
-    TN = _pick_tn(N, interpret, prefs=(256, 128))
-    grid = (N // TN, K // TK)
-    return pl.pallas_call(
+    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q6K)
+    in_specs, out_spec = _q6k_specs(B, TN)
+    return plain_pallas_call(
         functools.partial(_q6k_matmul_kernel, interpret=interpret),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((B, TKA6), lambda n, k: (0, k)),
-            pl.BlockSpec((TN, TK // 2), lambda n, k: (n, k)),
-            pl.BlockSpec((TN, TK // 4), lambda n, k: (n, k)),
-            pl.BlockSpec((1, TN, 128), lambda n, k: (k, n, 0)),
-        ],
-        out_specs=pl.BlockSpec((B, TN), lambda n, k: (0, n)),
-        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
-        interpret=interpret,
+        (N // TN, K // TK), in_specs, out_spec,
+        jax.ShapeDtypeStruct((B, N), jnp.float32), interpret,
     )(xpa, q4, q2, sm)
 
 
@@ -284,17 +294,13 @@ def _q6k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q4: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA6) * TK
     N = q4.shape[1]
-    TN = _pick_tn(N, interpret, prefs=(256, 128))
+    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q6K)
+    in_specs, out_spec = _q6k_specs(B, TN)
     call = stacked_pallas_call(
         functools.partial(_q6k_matmul_kernel, interpret=interpret),
         grid=(N // TN, K // TK),
-        in_specs=[
-            ((B, TKA6), lambda n, k: (0, k)),
-            ((TN, TK // 2), lambda n, k: (n, k)),
-            ((TN, TK // 4), lambda n, k: (n, k)),
-            ((1, TN, 128), lambda n, k: (k, n, 0)),
-        ],
-        out_spec=((B, TN), lambda n, k: (0, n)),
+        in_specs=in_specs,
+        out_spec=out_spec,
         out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
         interpret=interpret,
     )

@@ -43,6 +43,7 @@ from .qmatmul import (
     batched_rows,
     permute_x,
     q4k_compatible,
+    plain_pallas_call,
     stacked_pallas_call,
     stacked_partitioned,
 )
@@ -105,23 +106,32 @@ def _q8_matmul_kernel(xp_ref, q8_ref, sm_ref, o_ref, *, interpret):
     o_ref[...] += part
 
 
+_TN_PREFS_Q8 = (256, 128)
+
+
+def _q8_specs(B: int, TN: int):
+    """Single tiling definition for both the unstacked and stacked calls
+    (see qmatmul._q4k_specs)."""
+    return (
+        [
+            ((B, TK), lambda n, k: (0, k)),
+            ((TN, TK), lambda n, k: (n, k)),
+            ((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        ((B, TN), lambda n, k: (0, n)),
+    )
+
+
 def _q8_2d_raw(xp: jax.Array, q8: jax.Array, sm: jax.Array,
                interpret: bool) -> jax.Array:
     B, K = xp.shape
     N = q8.shape[0]
-    TN = _pick_tn(N, interpret, prefs=(256, 128))
-    grid = (N // TN, K // TK)
-    return pl.pallas_call(
+    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q8)
+    in_specs, out_spec = _q8_specs(B, TN)
+    return plain_pallas_call(
         functools.partial(_q8_matmul_kernel, interpret=interpret),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((B, TK), lambda n, k: (0, k)),
-            pl.BlockSpec((TN, TK), lambda n, k: (n, k)),
-            pl.BlockSpec((1, TN, 128), lambda n, k: (k, n, 0)),
-        ],
-        out_specs=pl.BlockSpec((B, TN), lambda n, k: (0, n)),
-        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
-        interpret=interpret,
+        (N // TN, K // TK), in_specs, out_spec,
+        jax.ShapeDtypeStruct((B, N), jnp.float32), interpret,
     )(xp, q8, sm)
 
 
@@ -169,16 +179,13 @@ def _q8_2d_stacked_raw(idx: jax.Array, xp: jax.Array, q8: jax.Array,
                        sm: jax.Array, interpret: bool) -> jax.Array:
     B, K = xp.shape
     N = q8.shape[1]
-    TN = _pick_tn(N, interpret, prefs=(256, 128))
+    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q8)
+    in_specs, out_spec = _q8_specs(B, TN)
     call = stacked_pallas_call(
         functools.partial(_q8_matmul_kernel, interpret=interpret),
         grid=(N // TN, K // TK),
-        in_specs=[
-            ((B, TK), lambda n, k: (0, k)),
-            ((TN, TK), lambda n, k: (n, k)),
-            ((1, TN, 128), lambda n, k: (k, n, 0)),
-        ],
-        out_spec=((B, TN), lambda n, k: (0, n)),
+        in_specs=in_specs,
+        out_spec=out_spec,
         out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
         interpret=interpret,
     )

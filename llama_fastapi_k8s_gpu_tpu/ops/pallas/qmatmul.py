@@ -234,24 +234,50 @@ def _pick_tn(n: int, interpret: bool, prefs: tuple = (512, 256, 128)) -> int:
     raise ValueError(f"N={n} not divisible by 128")
 
 
+_TN_PREFS_Q4K = (512, 256, 128)  # 512 measured fastest (docs/bench)
+
+
+def _q4k_specs(B: int, TN: int):
+    """(in_specs, out_spec) as (block_shape, index_map) pairs — the single
+    tiling definition consumed by BOTH the unstacked pallas_call (output
+    head) and the stacked scalar-prefetch call (per-layer serving path),
+    so the two can't drift."""
+    return (
+        [
+            ((B, TKA), lambda n, k: (0, k)),
+            ((TN, TK // 2), lambda n, k: (n, k)),
+            ((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        ((B, TN), lambda n, k: (0, n)),
+    )
+
+
+def plain_pallas_call(kernel, grid, in_specs, out_spec, out_shape,
+                      interpret: bool):
+    """pl.pallas_call from the same (block_shape, index_map) pairs
+    :func:`stacked_pallas_call` consumes."""
+    o_block, o_map = out_spec
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(b, m) for b, m in in_specs],
+        out_specs=pl.BlockSpec(o_block, o_map),
+        out_shape=out_shape,
+        interpret=interpret,
+    )
+
+
 def _q4k_2d_raw(xpa: jax.Array, qs: jax.Array, sm: jax.Array,
                 interpret: bool) -> jax.Array:
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = qs.shape[0]
-    TN = _pick_tn(N, interpret)
-    grid = (N // TN, K // TK)
-    return pl.pallas_call(
+    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q4K)
+    in_specs, out_spec = _q4k_specs(B, TN)
+    return plain_pallas_call(
         functools.partial(_q4k_matmul_kernel, interpret=interpret),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((B, TKA), lambda n, k: (0, k)),
-            pl.BlockSpec((TN, TK // 2), lambda n, k: (n, k)),
-            pl.BlockSpec((1, TN, 128), lambda n, k: (k, n, 0)),
-        ],
-        out_specs=pl.BlockSpec((B, TN), lambda n, k: (0, n)),
-        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
-        interpret=interpret,
+        (N // TN, K // TK), in_specs, out_spec,
+        jax.ShapeDtypeStruct((B, N), jnp.float32), interpret,
     )(xpa, qs, sm)
 
 
@@ -390,16 +416,13 @@ def _q4k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, qs: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = qs.shape[1]
-    TN = _pick_tn(N, interpret)
+    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q4K)
+    in_specs, out_spec = _q4k_specs(B, TN)
     call = stacked_pallas_call(
         functools.partial(_q4k_matmul_kernel, interpret=interpret),
         grid=(N // TN, K // TK),
-        in_specs=[
-            ((B, TKA), lambda n, k: (0, k)),
-            ((TN, TK // 2), lambda n, k: (n, k)),
-            ((1, TN, 128), lambda n, k: (k, n, 0)),
-        ],
-        out_spec=((B, TN), lambda n, k: (0, n)),
+        in_specs=in_specs,
+        out_spec=out_spec,
         out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
         interpret=interpret,
     )
