@@ -89,12 +89,18 @@ class _Slot:
     __slots__ = ("future", "gens", "budget", "n_prompt", "ids",
                  "first_token", "stops", "st", "sp", "t_admit", "ttft_s",
                  "sink", "abandoned", "dec", "n_emitted", "sent_bytes",
-                 "held", "cid", "created")
+                 "held", "cid", "created", "finished", "pending_first")
 
     def __init__(self, item: _Item, budget, n_prompt, ids):
         self.future = item.future
         self.sink = item.sink
         self.abandoned = item.abandoned
+        self.finished = False   # set when resolved; the pipelined loop may
+        #                         still hold this slot in an in-flight
+        #                         chunk's lane snapshot — harvest skips it
+        self.pending_first = False  # first token still on device (deferred
+        #                             admission fetch); materialized at the
+        #                             slot's first harvest
         self.gens: list[int] = []
         self.budget = budget
         self.n_prompt = n_prompt
@@ -354,7 +360,16 @@ class ContinuousEngine(MeshEngine):
         adm["offset"] = off + C
 
     def _finish_admission(self, adm: dict, lane: int, slots: list) -> None:
-        """Prefill complete: sample the first token, write the lane, install."""
+        """Prefill complete: sample the first token, write the lane, install.
+
+        When other lanes are decoding, the first-token fetch is DEFERRED
+        (async copy now, materialized at the slot's first harvest): a
+        blocking ``int(token)`` here drains the whole queued device
+        pipeline through the dispatch round-trip on every admission, which
+        under churn serializes the loop and starves live lanes (measured:
+        batch-4 aggregate throughput below a single lane's).  With no live
+        lanes nothing is starved, so the synchronous path keeps the
+        tightest TTFT for unloaded traffic."""
         item = adm["item"]
         try:
             ids, n_prompt, st = adm["ids"], adm["n_prompt"], adm["st"]
@@ -370,11 +385,21 @@ class ContinuousEngine(MeshEngine):
             budget = min(self._token_budget(item.max_tokens, n_prompt),
                          max(0, self.cfg.n_ctx - 1 - n_prompt))
             slot = _Slot(item, budget, n_prompt, ids)
-            slot.first_token = int(token)   # host sync: prefill done = TTFT
             slot.stops = item.stops
             slot.st = st
             slot.sp = item.sp
             slot.t_admit = adm["t0"]
+            if any(s is not None for s in slots):
+                try:
+                    token.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — optional fast path
+                    pass
+                slot.first_token = token        # device array
+                slot.ttft_s = None              # set at materialize
+                slot.pending_first = True
+                slots[lane] = slot
+                return
+            slot.first_token = int(token)   # host sync: prefill done = TTFT
             slot.ttft_s = time.time() - adm["t0"]
             if slot.sink is not None:       # stream: open the chunk stream
                 slot.sink.put(self._chunk(slot, {"role": "assistant"}))
@@ -384,6 +409,30 @@ class ContinuousEngine(MeshEngine):
                 item.future.set_exception(e)
             elif item.sink is not None:
                 item.sink.put(e)
+
+    def _materialize_first(self, lane: int, slot: _Slot, slots: list) -> None:
+        """Deferred-admission bookkeeping, run at the slot's first harvest
+        (its sample landed before the chunk just fetched, so this fetch
+        does not wait on new device work): first-token value, TTFT, stream
+        open, first stop/budget checks."""
+        slot.pending_first = False
+        try:
+            slot.first_token = int(slot.first_token)
+        except Exception as e:  # noqa: BLE001 — per-request isolation
+            slot.finished = True
+            if slots[lane] is slot:
+                slots[lane] = None
+            if slot.sink is not None:
+                slot.sink.put(e)
+            elif not slot.future.done():
+                slot.future.set_exception(e)
+            return
+        slot.ttft_s = time.time() - slot.t_admit
+        if slot.sink is not None:
+            slot.sink.put(self._chunk(slot, {"role": "assistant"}))
+        self._install(lane, slots, slot)
+        if slot.finished and slots[lane] is slot:
+            slots[lane] = None
 
     def _chunk(self, slot: _Slot, delta: dict, finish=None) -> dict:
         return {
@@ -432,6 +481,7 @@ class ContinuousEngine(MeshEngine):
         }
 
     def _finish_slot(self, slot: _Slot, finish: str):
+        slot.finished = True
         timings = self._slot_timings(slot)
         self._record_timings(timings)
         if slot.sink is not None:
@@ -522,13 +572,68 @@ class ContinuousEngine(MeshEngine):
             self._finish_admission(adm, lane, slots)
         return True
 
+    def _harvest(self, pre: list, chunk: "np.ndarray", slots: list) -> None:
+        """Fold one fetched decode chunk into its lanes' slots.
+
+        ``pre`` is the lane snapshot taken when the chunk was DISPATCHED —
+        with the pipelined loop that is one iteration ago, so a lane's slot
+        may have finished (budget/stop found in the previous chunk) while
+        this chunk was already in flight on the device; those rows are
+        discarded (``slot.finished``).  Abandoned requests (client timeout /
+        disconnect) free their lane here instead of decoding to budget:
+        unlike the reference's serial engine (api.py:97-100, where a
+        discarded generation delays nobody), an occupied lane would hold up
+        waiting requests."""
+        stop_ids = self.tokenizer.stop_ids
+        for lane in range(len(pre)):
+            slot = pre[lane]
+            if slot is None or slot.finished:
+                continue
+            if slot.pending_first:
+                # deferred admission: its sample was queued before the chunk
+                # just fetched — materialize the first token now, then fold
+                # in this chunk's rows (its tokens 2..n for this lane)
+                self._materialize_first(lane, slot, slots)
+                if slot.finished:
+                    continue
+            if slot.abandoned.is_set() or (
+                    slot.future is not None and slot.future.cancelled()):
+                slot.finished = True
+                if slot.sink is not None:
+                    slot.sink.put(_STREAM_END)
+                elif not slot.future.done():
+                    # resolve so a caller still awaiting (e.g. via
+                    # asyncio.wrap_future) unblocks as cancelled
+                    slot.future.set_exception(CancelledError())
+                if slots[lane] is slot:
+                    slots[lane] = None
+                continue
+            finish = None
+            for t in chunk[:, lane].tolist():
+                if t in stop_ids:
+                    finish = "stop"
+                    break
+                slot.gens.append(t)
+                if len(slot.gens) >= slot.budget:
+                    finish = "length"
+                    break
+            if finish is not None:
+                self._finish_slot(slot, finish)
+                if slots[lane] is slot:
+                    slots[lane] = None
+            elif slot.sink is not None:
+                if self._emit_stream(slot, done=False) == "stop":
+                    self._finish_slot(slot, "stop")
+                    if slots[lane] is slot:
+                        slots[lane] = None
+
     def _loop(self):
         B = self.batch_size
         slots: list[_Slot | None] = [None] * B
-        stop_ids = self.tokenizer.stop_ids
+        pending = None   # (lane snapshot, un-fetched device tokens)
         try:
             while not self._stop:
-                if not any(s is not None for s in slots):
+                if not any(s is not None for s in slots) and pending is None:
                     # nothing decoding: admission prefills stall nobody;
                     # drive the machine at full speed until a lane fills
                     progressed = False
@@ -544,62 +649,39 @@ class ContinuousEngine(MeshEngine):
 
                 # ---- one decode chunk for every live lane (per-lane sampling
                 # knobs incl. traced top_k ride in self._lane_st; the static
-                # k is the engine-wide ceiling).  Dispatch is async: the chunk
-                # queues on the device NOW, before any admission work, so
-                # live lanes never wait on admissions (VERDICT r2 weak #4 —
-                # the round-2 loop ran up to B serial prefills between chunks,
-                # stalling every live lane for hundreds of ms each).
-                pre = list(slots)   # lanes live in THIS chunk
-                self._bstate, toks = batched_generate_chunk_perlane_jit(
-                    self.params, self.cfg, self._bstate, self._lane_st,
-                    n_steps=self.decode_chunk, top_k=self._max_top_k)
+                # k is the engine-wide ceiling).  Dispatch is async AND
+                # pipelined one chunk deep: this chunk queues on the device
+                # BEFORE the previous chunk's tokens are fetched, so the
+                # host round-trip (dispatch latency; ~72 ms on the tunneled
+                # bench device) overlaps device compute instead of
+                # serializing with it.  Cost of the pipeline: a lane whose
+                # request finished in the previous chunk decodes one extra
+                # chunk before being freed (its rows are discarded), and an
+                # admission lands one chunk later.
+                if any(s is not None for s in slots):
+                    pre = list(slots)   # lanes live in THIS chunk
+                    self._bstate, toks = batched_generate_chunk_perlane_jit(
+                        self.params, self.cfg, self._bstate, self._lane_st,
+                        n_steps=self.decode_chunk, top_k=self._max_top_k)
+                    dispatched = (pre, toks)
+                else:
+                    dispatched = None
 
                 # ---- overlap: at most ONE admission prefill SLICE per chunk
                 # runs while the chunk executes; the lane write queues after
-                # the chunk on device, and an admitted request's tokens start
-                # with the NEXT chunk (pre[] snapshots who gets this chunk's
-                # rows).  Chunked prefill bounds the per-iteration stall to
-                # one slice even for a full-bucket prompt.
+                # the dispatched chunks, and an admitted request's tokens
+                # start with the chunk dispatched NEXT iteration (pre[]
+                # snapshots who gets each chunk's rows).  Chunked prefill
+                # bounds the per-iteration stall to one slice even for a
+                # full-bucket prompt.
                 self._admit_step(slots)
 
-                chunk = np.asarray(toks)                   # (n_steps, B)
-
-                # ---- harvest ----------------------------------------------
-                # Abandoned requests (client timeout/disconnect) free their
-                # lane here instead of decoding to budget: unlike the
-                # reference's serial engine (api.py:97-100, where a discarded
-                # generation delays nobody), an occupied lane would hold up
-                # waiting requests.
-                for lane in range(B):
-                    slot = pre[lane]
-                    if slot is None:
-                        continue
-                    if slot.abandoned.is_set() or (
-                            slot.future is not None and slot.future.cancelled()):
-                        if slot.sink is not None:
-                            slot.sink.put(_STREAM_END)
-                        elif not slot.future.done():
-                            # resolve so a caller still awaiting (e.g. via
-                            # asyncio.wrap_future) unblocks as cancelled
-                            slot.future.set_exception(CancelledError())
-                        slots[lane] = None
-                        continue
-                    finish = None
-                    for t in chunk[:, lane].tolist():
-                        if t in stop_ids:
-                            finish = "stop"
-                            break
-                        slot.gens.append(t)
-                        if len(slot.gens) >= slot.budget:
-                            finish = "length"
-                            break
-                    if finish is not None:
-                        self._finish_slot(slot, finish)
-                        slots[lane] = None
-                    elif slot.sink is not None:
-                        if self._emit_stream(slot, done=False) == "stop":
-                            self._finish_slot(slot, "stop")
-                            slots[lane] = None
+                # ---- harvest the PREVIOUS chunk (fetch blocks only until
+                # that chunk is done; the one dispatched above keeps the
+                # device busy meanwhile) -----------------------------------
+                if pending is not None:
+                    self._harvest(pending[0], np.asarray(pending[1]), slots)
+                pending = dispatched
         except BaseException as e:  # noqa: BLE001 — fail all, loudly
             self._loop_error = e
             logger.exception("scheduler loop died")

@@ -46,6 +46,7 @@ from .qmatmul import (
     permute_x,
     q4k_compatible,
     plain_pallas_call,
+    rows_vmappable,
     stacked_pallas_call,
     stacked_partitioned,
 )
@@ -247,7 +248,7 @@ def _q5k_2d_partitioned(interpret: bool):
         infer_sharding_from_operands=infer,
         sharding_rule="b k, n j, n p, t n l -> b n",
     )
-    return jax.jit(fn)
+    return jax.jit(rows_vmappable(fn, xpa_pos=0))
 
 
 def _q5k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q5s: jax.Array,

@@ -57,6 +57,7 @@ from .qmatmul import (
     batched_rows,
     q4k_compatible,
     plain_pallas_call,
+    rows_vmappable,
     stacked_pallas_call,
     stacked_partitioned,
 )
@@ -285,7 +286,7 @@ def _q6k_2d_partitioned(interpret: bool):
         infer_sharding_from_operands=infer,
         sharding_rule="b k, n j, n p, t n l -> b n",
     )
-    return jax.jit(fn)
+    return jax.jit(rows_vmappable(fn, xpa_pos=0))
 
 
 def _q6k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q4: jax.Array,

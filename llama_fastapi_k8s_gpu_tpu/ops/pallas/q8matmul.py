@@ -44,6 +44,7 @@ from .qmatmul import (
     permute_x,
     q4k_compatible,
     plain_pallas_call,
+    rows_vmappable,
     stacked_pallas_call,
     stacked_partitioned,
 )
@@ -172,7 +173,7 @@ def _q8_2d_partitioned(interpret: bool):
         infer_sharding_from_operands=infer,
         sharding_rule="b k, n j, t n l -> b n",
     )
-    return jax.jit(fn)
+    return jax.jit(rows_vmappable(fn, xpa_pos=0))
 
 
 def _q8_2d_stacked_raw(idx: jax.Array, xp: jax.Array, q8: jax.Array,

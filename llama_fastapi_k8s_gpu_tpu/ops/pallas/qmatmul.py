@@ -335,7 +335,7 @@ def _q4k_2d_partitioned(interpret: bool):
         # (k, j, t) stay unsplit by construction of the mesh.py shardings
         sharding_rule="b k, n j, t n l -> b n",
     )
-    return jax.jit(fn)
+    return jax.jit(rows_vmappable(fn, xpa_pos=0))
 
 
 # ---------------------------------------------------------------------------
@@ -429,6 +429,40 @@ def _q4k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, qs: jax.Array,
     return call(idx, xpa, qs, sm)
 
 
+def rows_vmappable(fn, xpa_pos: int):
+    """Give a fused matmul a vmap rule: batching over the activation
+    operand is just more rows for the kernel (weights are shared across
+    the batch).  ``custom_partitioning`` has no batching rule in JAX, so
+    without this the vmapped engines (parallel/batched.py — the
+    mesh-batched and continuous serving paths) raise
+    ``NotImplementedError: Batching rule for 'custom_partitioning'`` the
+    first time they meet fused weights."""
+    from jax.custom_batching import custom_vmap
+
+    @custom_vmap
+    def wrapped(*args):
+        return fn(*args)
+
+    @wrapped.def_vmap
+    def _rule(axis_size, in_batched, *args):  # noqa: ANN001
+        if not in_batched[xpa_pos] or any(
+                b for i, b in enumerate(in_batched) if i != xpa_pos):
+            raise NotImplementedError(
+                "fused matmul vmap: only the activation operand may carry "
+                "the batch axis (weights are shared)")
+        xpa = args[xpa_pos]
+        nb, B, KA = xpa.shape
+        # re-chunk the flattened rows: the caller's batched_rows bound was
+        # applied to the PER-LANE shape, so nb*B can exceed _MAX_B and blow
+        # the kernel's activation/output VMEM blocks at large lane counts
+        out = batched_rows(
+            lambda xp: fn(*args[:xpa_pos], xp, *args[xpa_pos + 1:]),
+            xpa.reshape(nb * B, KA))
+        return out.reshape(nb, B, -1), True
+
+    return wrapped
+
+
 def stacked_partitioned(raw_fn, sharding_rule: str, interpret: bool):
     """GSPMD rule shared by every stacked fused matmul — same contract as
     the unstacked kernels (partition over N and rows, never K) plus: the
@@ -471,7 +505,7 @@ def stacked_partitioned(raw_fn, sharding_rule: str, interpret: bool):
         infer_sharding_from_operands=infer,
         sharding_rule=sharding_rule,
     )
-    return jax.jit(fn)
+    return jax.jit(rows_vmappable(fn, xpa_pos=1))
 
 
 @functools.lru_cache(maxsize=4)

@@ -99,6 +99,36 @@ _PLANE_SPEC = {
 
 
 @pytest.mark.parametrize("fmt", ["q4k", "q5k", "q6k", "q8"])
+def test_vmapped_fused_matmul(fmt):
+    """The mesh-batched/continuous engines vmap the model over lanes with
+    SHARED fused weights (parallel/batched.py).  custom_partitioning has no
+    batching rule in JAX, so without the rows_vmappable custom_vmap rule
+    this raised ``NotImplementedError: Batching rule for
+    'custom_partitioning' not implemented`` — first seen on hardware,
+    because CPU tests' tiny dims always fell back to int8."""
+    rng = np.random.default_rng(11)
+    L, n, k, lanes = 2, 16, 2048, 3
+    ws = [MAKERS[fmt](rng.standard_normal((n, k)).astype(np.float32) * 0.02)
+          for _ in range(L)]
+    xs = jnp.asarray(rng.standard_normal((lanes, 2, k)), jnp.bfloat16)
+
+    got = jax.vmap(lambda x: linear(x, ws[0]))(xs)
+    for b in range(lanes):
+        ref = np.asarray(linear(xs[b], ws[0]).astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(got[b].astype(jnp.float32)), ref, rtol=1e-3,
+            atol=1e-3 * (np.abs(ref).max() + 1e-6))
+
+    stacked = _stack(ws)
+    got = jax.vmap(lambda x: linear_at(x, stacked, jnp.int32(1)))(xs)
+    for b in range(lanes):
+        ref = np.asarray(linear(xs[b], ws[1]).astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(got[b].astype(jnp.float32)), ref, rtol=1e-3,
+            atol=1e-3 * (np.abs(ref).max() + 1e-6))
+
+
+@pytest.mark.parametrize("fmt", ["q4k", "q5k", "q6k", "q8"])
 def test_stacked_partitioned_matches_unsharded(fmt):
     rng = np.random.default_rng(9)
     L, n, k = 2, 256, 2048
