@@ -194,13 +194,19 @@ def main() -> None:
     oks, rejects, errors = [], [], []
     lock = threading.Lock()
 
-    def worker():
+    def worker(seed: int):
         # closed loop: each thread completes `per` requests, retrying 503s
-        # with a short backoff (clients do the same), so the phase sustains
-        # the advertised concurrency instead of collapsing to queue+1 after
-        # an initial burst of rejections; every 503 is still counted
+        # with exponential backoff + jitter (what a real client does), so
+        # the phase sustains the advertised concurrency and still counts
+        # every 503.  A fixed short backoff instead synchronizes the
+        # excess threads into a retry stampede that starves queued
+        # requests into 408s at >1.3x overload (observed on-chip).
+        import random
+
+        rnd = random.Random(seed)
         done = 0
         attempts = 0
+        backoff = 0.1
         while done < per and attempts < per * 200:
             attempts += 1
             t0 = time.perf_counter()
@@ -210,11 +216,13 @@ def main() -> None:
                 with lock:
                     oks.append((time.perf_counter() - t0) * 1e3)
                 done += 1
+                backoff = 0.1
             except urllib.error.HTTPError as e:
                 with lock:
                     (rejects if e.code == 503 else errors).append(e.code)
                 if e.code == 503:
-                    time.sleep(0.05)
+                    time.sleep(backoff * (0.5 + rnd.random()))
+                    backoff = min(backoff * 2, 1.6)
                 else:
                     done += 1   # non-503 failure: don't retry forever
             except Exception as e:  # noqa: BLE001 — connection-level failure:
@@ -223,7 +231,7 @@ def main() -> None:
                 done += 1
 
     t_conc = time.perf_counter()
-    ths = [threading.Thread(target=worker) for _ in range(conc)]
+    ths = [threading.Thread(target=worker, args=(i,)) for i in range(conc)]
     for t in ths:
         t.start()
     for t in ths:

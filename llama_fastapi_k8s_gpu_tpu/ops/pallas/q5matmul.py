@@ -41,6 +41,7 @@ from .qmatmul import (
     _interpret,
     _pick_tn,
     _spec_axis,
+    _tn_prefs_for,
     augment_x,
     batched_rows,
     permute_x,
@@ -201,7 +202,7 @@ def _q5k_2d_raw(xpa: jax.Array, q5s: jax.Array, q5h: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = q5s.shape[0]
-    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q5K)
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q5K))
     in_specs, out_spec = _q5k_specs(B, TN)
     return plain_pallas_call(
         functools.partial(_q5k_matmul_kernel, interpret=interpret),
@@ -257,7 +258,7 @@ def _q5k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q5s: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = q5s.shape[1]
-    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q5K)
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q5K))
     in_specs, out_spec = _q5k_specs(B, TN)
     call = stacked_pallas_call(
         functools.partial(_q5k_matmul_kernel, interpret=interpret),

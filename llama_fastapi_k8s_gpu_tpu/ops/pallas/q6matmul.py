@@ -54,6 +54,7 @@ from .qmatmul import (
     _interpret,
     _pick_tn,
     _spec_axis,
+    _tn_prefs_for,
     batched_rows,
     q4k_compatible,
     plain_pallas_call,
@@ -239,7 +240,7 @@ def _q6k_2d_raw(xpa: jax.Array, q4: jax.Array, q2: jax.Array, sm: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA6) * TK
     N = q4.shape[0]
-    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q6K)
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q6K))
     in_specs, out_spec = _q6k_specs(B, TN)
     return plain_pallas_call(
         functools.partial(_q6k_matmul_kernel, interpret=interpret),
@@ -295,7 +296,7 @@ def _q6k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q4: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA6) * TK
     N = q4.shape[1]
-    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q6K)
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q6K))
     in_specs, out_spec = _q6k_specs(B, TN)
     call = stacked_pallas_call(
         functools.partial(_q6k_matmul_kernel, interpret=interpret),

@@ -40,6 +40,7 @@ from .qmatmul import (
     _interpret,
     _pick_tn,
     _spec_axis,
+    _tn_prefs_for,
     batched_rows,
     permute_x,
     q4k_compatible,
@@ -127,7 +128,7 @@ def _q8_2d_raw(xp: jax.Array, q8: jax.Array, sm: jax.Array,
                interpret: bool) -> jax.Array:
     B, K = xp.shape
     N = q8.shape[0]
-    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q8)
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q8))
     in_specs, out_spec = _q8_specs(B, TN)
     return plain_pallas_call(
         functools.partial(_q8_matmul_kernel, interpret=interpret),
@@ -180,7 +181,7 @@ def _q8_2d_stacked_raw(idx: jax.Array, xp: jax.Array, q8: jax.Array,
                        sm: jax.Array, interpret: bool) -> jax.Array:
     B, K = xp.shape
     N = q8.shape[1]
-    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q8)
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q8))
     in_specs, out_spec = _q8_specs(B, TN)
     call = stacked_pallas_call(
         functools.partial(_q8_matmul_kernel, interpret=interpret),

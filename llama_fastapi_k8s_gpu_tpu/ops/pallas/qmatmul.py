@@ -234,7 +234,18 @@ def _pick_tn(n: int, interpret: bool, prefs: tuple = (512, 256, 128)) -> int:
     raise ValueError(f"N={n} not divisible by 128")
 
 
-_TN_PREFS_Q4K = (512, 256, 128)  # 512 measured fastest (docs/bench)
+_TN_PREFS_Q4K = (512, 256, 128)  # 512 measured fastest for decode (docs/bench)
+
+
+def _tn_prefs_for(B: int, prefs: tuple) -> tuple:
+    """Cap TN at 256 for large row blocks: at prefill sizes the (B, TKA)
+    activation block plus TN=512's dequant intermediates crowd VMEM —
+    measured 24.1 → 16.3 ms for the 8B ffn gate+down pair at 4096 rows
+    when dropping to TN=256 with 256-row chunks (chip, 2026-07-30).
+    Decode (B ≤ 128) keeps the measured-fastest TN=512."""
+    if B > 128:
+        return tuple(t for t in prefs if t <= 256) or prefs[-1:]
+    return prefs
 
 
 def _q4k_specs(B: int, TN: int):
@@ -272,7 +283,7 @@ def _q4k_2d_raw(xpa: jax.Array, qs: jax.Array, sm: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = qs.shape[0]
-    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q4K)
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q4K))
     in_specs, out_spec = _q4k_specs(B, TN)
     return plain_pallas_call(
         functools.partial(_q4k_matmul_kernel, interpret=interpret),
@@ -416,7 +427,7 @@ def _q4k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, qs: jax.Array,
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = qs.shape[1]
-    TN = _pick_tn(N, interpret, prefs=_TN_PREFS_Q4K)
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q4K))
     in_specs, out_spec = _q4k_specs(B, TN)
     call = stacked_pallas_call(
         functools.partial(_q4k_matmul_kernel, interpret=interpret),
@@ -528,9 +539,12 @@ def q4k_matmul_stacked(x: jax.Array, w: dict, idx,
     return y.reshape(*lead, -1).astype(x.dtype)
 
 
-_MAX_B = 128  # rows per kernel call: bounds the xpa/out VMEM blocks (the
-              # weight-tile intermediates dominate at ~10 MB of the ~16 MB
-              # VMEM with TN=512, so the activation side stays small).
+_MAX_B = 256  # rows per kernel call: bounds the xpa/out VMEM blocks.
+              # Rows > 128 force TN <= 256 (_tn_prefs_for), so at this
+              # bound the budget is ~4.3 MB activations + ~6 MB TN=256
+              # dequant intermediates — measured fastest for prefill-size
+              # row counts (docs/bench, 2026-07-30: 24.1 -> 16.3 ms for
+              # the 8B ffn pair at 4096 rows vs 128-row/TN=512 chunks).
               # Shared by every fused kernel via batched_rows().
 
 
