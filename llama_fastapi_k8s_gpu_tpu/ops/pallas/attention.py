@@ -58,41 +58,76 @@ def _attn_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]                                   # (BQ, hd)
-    k = k_ref[0]                                   # (BK, hd)
-    scores = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * sm_scale                                   # (BQ, BK)
+    # Block-level causal classification (the VPU fix: the kernel was
+    # mask/softmax-bound, spending identical VPU work on fully-masked
+    # future blocks and on interior blocks that need no masking at all).
+    # Query tokens of this tile: rows are (group, S)-flattened, so token =
+    # row % S.  The tight span bound needs the tile to cover one contiguous
+    # token range, which holds iff S % BQ == 0; any other shape (tile
+    # wrapping mid-span, or spanning whole copies) falls back to the
+    # conservative full range [0, S-1] — always correct, just fewer
+    # skip/interior blocks.
+    if block_q < seq_len and seq_len % block_q == 0:
+        t_min = jax.lax.rem(qb * block_q, seq_len)
+        t_max = t_min + block_q - 1
+    else:
+        t_min = 0
+        t_max = seq_len - 1
+    q_min = pos_ref[0] + t_min
+    q_max = pos_ref[0] + t_max
+    kmin = kb * block_k
+    kmax = kmin + block_k - 1
 
-    # query cache positions: row r of this tile is query token (qb*BQ + r) % S
-    # (rows are (group, S)-flattened; all group copies share positions).
-    row = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    q_pos = pos_ref[0] + jax.lax.rem(row, seq_len)
-    key_pos = kb * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
-    mask = key_pos <= q_pos
+    skip = kmin > q_max                            # fully in the masked future
     if sliding_window:
-        mask &= key_pos > q_pos - sliding_window
-    scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
+        skip |= kmax <= q_min - sliding_window     # fully behind the window
+        interior = jnp.bool_(False)                # window edge → always mask
+    else:
+        interior = kmax <= q_min                   # fully unmasked block
 
-    m_prev = m_ref[:, :1]                          # (BQ, 1)
-    l_prev = l_ref[:, :1]
-    m_cur = jnp.max(scores, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)                # rescale of old state
-    p = jnp.exp(scores - m_new)                    # (BQ, BK)
-    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    def _body(masked: bool):
+        q = q_ref[0]                               # (BQ, hd)
+        k = k_ref[0]                               # (BK, hd)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                               # (BQ, BK)
 
-    v = v_ref[0]                                   # (BK, hd)
-    pv = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_ref[...] = acc_ref[...] * alpha + pv
-    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        if masked:
+            row = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            q_pos = pos_ref[0] + jax.lax.rem(row, seq_len)
+            key_pos = kmin + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = key_pos <= q_pos
+            if sliding_window:
+                mask &= key_pos > q_pos - sliding_window
+            scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
+
+        m_prev = m_ref[:, :1]                      # (BQ, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)            # rescale of old state
+        p = jnp.exp(scores - m_new)                # (BQ, BK)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0]                               # (BK, hd)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(skip), interior))
+    def _interior():
+        _body(masked=False)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(skip), jnp.logical_not(interior)))
+    def _edge():
+        _body(masked=True)
 
     @pl.when(kb == pl.num_programs(2) - 1)
     def _finish():
@@ -119,8 +154,8 @@ def flash_attention(
     pos_offset: jax.Array, # scalar int32: cache position of q[0]
     sm_scale: float,
     sliding_window: int = 0,
-    block_q: int = 128,
-    block_k: int = 256,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: bool = False,
 ) -> jax.Array:
     """Causal (+ sliding-window) attention of S queries over the KV ring.

@@ -238,11 +238,14 @@ _TN_PREFS_Q4K = (512, 256, 128)  # 512 measured fastest for decode (docs/bench)
 
 
 def _tn_prefs_for(B: int, prefs: tuple) -> tuple:
-    """Cap TN at 256 for large row blocks: at prefill sizes the (B, TKA)
-    activation block plus TN=512's dequant intermediates crowd VMEM —
-    measured 24.1 → 16.3 ms for the 8B ffn gate+down pair at 4096 rows
-    when dropping to TN=256 with 256-row chunks (chip, 2026-07-30).
-    Decode (B ≤ 128) keeps the measured-fastest TN=512."""
+    """Cap TN at 256 for large row blocks, bounding the (B, TKA) activation
+    block plus dequant-intermediate VMEM footprint.  Artifact-free chip
+    measurement (docs/PERF.md "Measurement hygiene") shows prefill-size
+    row counts perform the same at 128-row/TN=512 and 256-row/TN=256 for
+    every fused format (~16.5 ms for the 8B (4096, 14336) shape at 512
+    rows); the cap keeps the larger 256-row chunks (half the kernel
+    calls) safely inside VMEM.  Decode (B ≤ 128) keeps the
+    measured-fastest TN=512."""
     if B > 128:
         return tuple(t for t in prefs if t <= 256) or prefs[-1:]
     return prefs
@@ -540,12 +543,13 @@ def q4k_matmul_stacked(x: jax.Array, w: dict, idx,
 
 
 _MAX_B = 256  # rows per kernel call: bounds the xpa/out VMEM blocks.
-              # Rows > 128 force TN <= 256 (_tn_prefs_for), so at this
-              # bound the budget is ~4.3 MB activations + ~6 MB TN=256
-              # dequant intermediates — measured fastest for prefill-size
-              # row counts (docs/bench, 2026-07-30: 24.1 -> 16.3 ms for
-              # the 8B ffn pair at 4096 rows vs 128-row/TN=512 chunks).
-              # Shared by every fused kernel via batched_rows().
+              # Rows > 128 force TN <= 256 (_tn_prefs_for), keeping the
+              # budget at ~4.3 MB activations + ~6 MB dequant
+              # intermediates.  Chip-measured equal to 128-row/TN=512
+              # chunks for all four fused formats at prefill sizes
+              # (~16.5 ms for (4096, 14336) at 512 rows) with half the
+              # kernel calls.  Shared by every fused kernel via
+              # batched_rows().
 
 
 def batched_rows(fn, xpa: jax.Array, *weights) -> jax.Array:

@@ -120,6 +120,40 @@ def test_flash_attention_matches_xla(S, n_ctx, H, n_kv, hd, offset, window):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize(
+    "S,n_ctx,H,n_kv,hd,offset,window,bq,bk",
+    [
+        # multi-block grids so the causal block classifier's THREE branches
+        # all execute (attention.py: skip / interior-unmasked / edge-masked).
+        # Default-shaped CI cases compile to a single kv block with
+        # bq >= gs, where skip and interior are unreachable — a sign error
+        # in the block bounds would pass every other test and silently
+        # attend to future tokens at long context on hardware.
+        (64, 256, 4, 2, 32, 0, 0, 16, 32),     # tight span: S % bq == 0
+        (64, 256, 4, 2, 32, 100, 0, 16, 32),   # offset: fewer skips, interior
+        (64, 256, 4, 2, 32, 192, 0, 16, 32),   # queries at the ring's end
+        (64, 256, 4, 2, 32, 100, 48, 16, 32),  # sliding window: edge + skip
+        (24, 96, 4, 2, 32, 0, 0, 16, 32),      # S % bq != 0: tile wraps →
+                                               # conservative full-range path
+        (64, 256, 4, 2, 32, 64, 0, 128, 32),   # bq > S, bq % S == 0
+    ],
+)
+def test_flash_attention_block_branches(S, n_ctx, H, n_kv, hd, offset,
+                                        window, bq, bk):
+    keys = jax.random.split(jax.random.PRNGKey(7 * S + offset + bq), 3)
+    q = jax.random.normal(keys[0], (S, H, hd), jnp.float32)
+    k = jax.random.normal(keys[1], (n_ctx, n_kv, hd), jnp.float32)
+    v = jax.random.normal(keys[2], (n_ctx, n_kv, hd), jnp.float32)
+    sm = hd ** -0.5
+    got = flash_attention(
+        q, k, v, jnp.int32(offset), sm_scale=sm, sliding_window=window,
+        block_q=bq, block_k=bk, interpret=True,
+    )
+    want = _ref_attention(q, k, v, jnp.int32(offset), sm, window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_prefill_pallas_matches_xla_end_to_end():
     """Full model forward: logits with attn_impl=pallas ≈ attn_impl=xla."""
     cfg = ModelConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
