@@ -139,6 +139,7 @@ class ContinuousEngine(MeshEngine):
         # effectively min(requested, ceiling)
         self._max_top_k = max(max_top_k, SamplingParams().top_k)
         self._req_counter = 0                # monotonic request id (abandon key)
+        self._stats = {"lanes_live": 0, "pending": 0, "admission_inflight": 0}
         self._items: dict[int, _Item] = {}   # live request id → item (abandon)
         self._pending: queue_mod.Queue = queue_mod.Queue()
         self._wake = threading.Event()
@@ -572,6 +573,14 @@ class ContinuousEngine(MeshEngine):
             self._finish_admission(adm, lane, slots)
         return True
 
+    def scheduler_stats(self) -> dict:
+        """Point-in-time scheduler occupancy for ``/metrics`` (lanes_live,
+        pending queue depth, whether an admission prefill is in flight) —
+        the observability the lane model adds over the reference's single
+        queue-depth number.  Written once per loop iteration; reads are a
+        dict swap, no lock needed."""
+        return {"batch_size": self.batch_size, **self._stats}
+
     def _harvest(self, pre: list, chunk: "np.ndarray", slots: list) -> None:
         """Fold one fetched decode chunk into its lanes' slots.
 
@@ -682,10 +691,20 @@ class ContinuousEngine(MeshEngine):
                 if pending is not None:
                     self._harvest(pending[0], np.asarray(pending[1]), slots)
                 pending = dispatched
+                self._stats = {
+                    "lanes_live": sum(s is not None for s in slots),
+                    "pending": self._pending.qsize(),
+                    "admission_inflight": int(self._adm is not None),
+                }
         except BaseException as e:  # noqa: BLE001 — fail all, loudly
             self._loop_error = e
             logger.exception("scheduler loop died")
         finally:
+            # zero the occupancy gauges: a dead loop must not keep reporting
+            # its last pre-crash lanes_live/admission_inflight to /metrics,
+            # masking the outage from dashboards built on them
+            self._stats = {"lanes_live": 0, "pending": self._pending.qsize(),
+                           "admission_inflight": 0}
             # graceful stop AND crash both resolve every outstanding request:
             # a caller blocked in Future.result() or sink.get() must not hang
             err = self._loop_error or RuntimeError("engine has been shut down")

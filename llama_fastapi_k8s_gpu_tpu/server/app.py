@@ -576,6 +576,10 @@ def create_app(engine=None, settings: Settings | None = None,
         m = app.state.metrics
         if hasattr(app.state, "queue"):
             m.set_gauge("queue_depth", app.state.queue.qsize())
+        stats = getattr(app.state.engine, "scheduler_stats", None)
+        if stats is not None:
+            for k, v in stats().items():
+                m.set_gauge(f"scheduler_{k}", v)
         return PlainTextResponse(m.render())
 
     @app.get("/items/{item_id}")

@@ -8,6 +8,7 @@ and the server's no-barrier forwarding path.
 from __future__ import annotations
 
 import asyncio
+import time
 
 import pytest
 
@@ -398,3 +399,18 @@ def test_chunked_prefill_bounds_stall_per_slice(tmp_path):
         assert max(gaps) < 3 * delay, gaps
     finally:
         eng.shutdown()
+
+
+def test_scheduler_stats_surface(cengine):
+    """Occupancy stats for /metrics: keys present, consistent with config,
+    and updated by the loop (lanes_live returns to 0 after drain)."""
+    cengine.create_chat_completion(MSGS, temperature=0.0, max_tokens=4)
+    stats = cengine.scheduler_stats()
+    assert stats["batch_size"] == 4
+    assert set(stats) == {"batch_size", "lanes_live", "pending",
+                          "admission_inflight"}
+    deadline = time.time() + 10
+    while time.time() < deadline and cengine.scheduler_stats()["lanes_live"]:
+        time.sleep(0.05)
+    assert cengine.scheduler_stats()["lanes_live"] == 0
+    assert cengine.scheduler_stats()["pending"] == 0
