@@ -700,11 +700,6 @@ class ContinuousEngine(MeshEngine):
             self._loop_error = e
             logger.exception("scheduler loop died")
         finally:
-            # zero the occupancy gauges: a dead loop must not keep reporting
-            # its last pre-crash lanes_live/admission_inflight to /metrics,
-            # masking the outage from dashboards built on them
-            self._stats = {"lanes_live": 0, "pending": self._pending.qsize(),
-                           "admission_inflight": 0}
             # graceful stop AND crash both resolve every outstanding request:
             # a caller blocked in Future.result() or sink.get() must not hang
             err = self._loop_error or RuntimeError("engine has been shut down")
@@ -731,3 +726,9 @@ class ContinuousEngine(MeshEngine):
                     item.sink.put(err if self._loop_error else _STREAM_END)
                 elif not item.future.done() and not item.future.cancel():
                     item.future.set_exception(err)
+            # zero the occupancy gauges LAST (after the drain): a dead loop
+            # must not keep reporting pre-crash lanes_live/pending/
+            # admission_inflight to /metrics, masking the outage from
+            # dashboards built on them
+            self._stats = {"lanes_live": 0, "pending": 0,
+                           "admission_inflight": 0}

@@ -32,7 +32,14 @@ import numpy as np
 
 
 def timed(fn, state, iters, *, sync):
-    fn(state)  # compile + warm
+    # warm TWICE and discard: the first executions of a program family in
+    # a fresh process run 20-40x slow on this platform (docs/PERF.md
+    # "Measurement hygiene") — without this, whichever variant is timed
+    # first looks artificially slow
+    out = fn(state)
+    sync(out)
+    out = fn(state)
+    sync(out)
     t0 = time.time()
     out = fn(state)
     sync(out)
