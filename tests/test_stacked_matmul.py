@@ -128,6 +128,21 @@ def test_vmapped_fused_matmul(fmt):
             atol=1e-3 * (np.abs(ref).max() + 1e-6))
 
 
+def test_vmapped_fused_matmul_rejects_batched_weights():
+    """The rows_vmappable rule only supports a batched activation operand;
+    batching the weights (no engine does this) must raise loudly rather
+    than silently compute against the wrong layout."""
+    rng = np.random.default_rng(12)
+    n, k, lanes = 16, 2048, 2
+    ws = [make_linear_q4k(
+        rng.standard_normal((n, k)).astype(np.float32) * 0.02)
+        for _ in range(lanes)]
+    wb = _stack(ws)   # leading dim = lanes, used as a vmap axis below
+    x = jnp.asarray(rng.standard_normal((1, k)), jnp.bfloat16)
+    with pytest.raises(Exception, match="activation operand|batch"):
+        jax.vmap(lambda w: linear(x, w))(wb)
+
+
 @pytest.mark.parametrize("fmt", ["q4k", "q5k", "q6k", "q8"])
 def test_stacked_partitioned_matches_unsharded(fmt):
     rng = np.random.default_rng(9)
