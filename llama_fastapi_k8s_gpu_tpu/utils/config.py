@@ -50,12 +50,14 @@ class Settings:
 
     # TPU-native knobs (no reference equivalent).
     max_gen_tokens: int = 512
-    decode_chunk: int = 16          # device-side tokens per host round-trip.
-    # Chosen from bench.py's chunk sweep (docs/bench 2026-07-30): decode
-    # tok/s rises with chunk size (+~1% at 1k ctx, +4.7% at 8k for 32 vs
-    # 8) while streaming flush cadence degrades; 16 is the data-backed
-    # middle (~190 ms between SSE flushes at 8B speeds, within ~2% of the
-    # best decode rate at both context sizes).
+    decode_chunk: int = 8           # device-side tokens per host round-trip.
+    # Measured trade-off (docs/bench 2026-07-30): single-stream decode
+    # rises mildly with chunk size (+~1% at 1k ctx, +4.7% at 8k for 32 vs
+    # 8 — bench.py reports its sweep's best either way), but the chunk is
+    # ALSO the continuous scheduler's admission/stream cadence: at 16 the
+    # 8-lane aggregate dropped 160 -> 108 tok/s and stream TTFT doubled
+    # (209 -> 407 ms).  8 is the serving default; single-stream batch
+    # callers can raise LFKT_DECODE_CHUNK.
     prefill_buckets: str = "128,256,512,1024"  # padded prompt shapes to bound recompiles
     weight_format: str = "auto"     # auto | bf16 | int8 | q4k
     attn_impl: str = "auto"         # auto | xla | pallas (prefill flash kernel)
