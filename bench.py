@@ -59,8 +59,10 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
 
     ``fmt="int8"``: per-channel int8 (ops/linear.py).  ``fmt="q4k"``: the
     fused Q4_K kernel layout (ops/pallas/qmatmul.py) — random packed nibbles
-    + small scales; decode bandwidth is value-independent, so this measures
-    exactly what real Q4_K weights would.
+    + small scales.  ``fmt="q8"``: the fused Q8_0 layout
+    (ops/pallas/q8matmul.py) — the BASELINE's named Q8_0 config at ~1.13
+    B/weight.  Decode bandwidth is value-independent, so these measure
+    exactly what real quantized weights would.
     """
     import jax
     import jax.numpy as jnp
@@ -78,6 +80,12 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
             sm = jnp.full((L, in_dim // TK, out_dim, 128),
                           (in_dim ** -0.5) / 8.0, jnp.bfloat16)
             return {"qs": qs, "sm": sm}
+        if fmt == "q8" and q4k_compatible(out_dim, in_dim, for_tpu=True):
+            q8 = jax.random.randint(k, (L, out_dim, in_dim),
+                                    -127, 128, jnp.int8)
+            sm8 = jnp.full((L, in_dim // TK, out_dim, 128),
+                           (in_dim ** -0.5) / 127.0, jnp.bfloat16)
+            return {"q8": q8, "sm8": sm8}
         q = jax.random.randint(k, (L, out_dim, in_dim), -127, 128, jnp.int8)
         s = jnp.full((L, out_dim), (in_dim ** -0.5) / 127.0, jnp.float32)
         return {"q": q, "s": s}
@@ -99,21 +107,37 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
             "w_down": lin(ks[7], cfg.dim, cfg.ffn_dim),
         },
         "out_norm": jnp.ones(cfg.dim, jnp.float32),
-        "output": (
-            {
-                "qs": jax.random.randint(ks[0], (cfg.vocab_size, cfg.dim // 2),
-                                         -128, 128, jnp.int8),
-                "sm": jnp.full((cfg.dim // TK, cfg.vocab_size, 128),
-                               (cfg.dim ** -0.5) / 8.0, jnp.bfloat16),
-            }
-            if fmt == "q4k" and q4k_compatible(cfg.vocab_size, cfg.dim, for_tpu=True)
-            else {
-                "q": jax.random.randint(ks[0], (cfg.vocab_size, cfg.dim),
-                                        -127, 128, jnp.int8),
-                "s": jnp.full((cfg.vocab_size,), (cfg.dim ** -0.5) / 127.0,
-                              jnp.float32),
-            }
-        ),
+        "output": _synth_output_head(cfg, fmt, ks[0]),
+    }
+
+
+def _synth_output_head(cfg, fmt: str, key):
+    """Output-head weights in the bench format (unstacked — the head is not
+    part of the per-layer scan)."""
+    import jax
+    import jax.numpy as jnp
+
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import TK, q4k_compatible
+
+    if fmt == "q4k" and q4k_compatible(cfg.vocab_size, cfg.dim, for_tpu=True):
+        return {
+            "qs": jax.random.randint(key, (cfg.vocab_size, cfg.dim // 2),
+                                     -128, 128, jnp.int8),
+            "sm": jnp.full((cfg.dim // TK, cfg.vocab_size, 128),
+                           (cfg.dim ** -0.5) / 8.0, jnp.bfloat16),
+        }
+    if fmt == "q8" and q4k_compatible(cfg.vocab_size, cfg.dim, for_tpu=True):
+        return {
+            "q8": jax.random.randint(key, (cfg.vocab_size, cfg.dim),
+                                     -127, 128, jnp.int8),
+            "sm8": jnp.full((cfg.dim // TK, cfg.vocab_size, 128),
+                            (cfg.dim ** -0.5) / 127.0, jnp.bfloat16),
+        }
+    return {
+        "q": jax.random.randint(key, (cfg.vocab_size, cfg.dim),
+                                -127, 128, jnp.int8),
+        "s": jnp.full((cfg.vocab_size,), (cfg.dim ** -0.5) / 127.0,
+                      jnp.float32),
     }
 
 
@@ -301,7 +325,7 @@ def child_main() -> None:
     # reference api.py:14) and the Pallas flash prefill that
     # engine.Engine(attn_impl="auto") resolves to on TPU with head_dim 128.
     preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
-    wfmt = os.environ.get("LFKT_BENCH_FMT", "q4k")  # q4k | int8
+    wfmt = os.environ.get("LFKT_BENCH_FMT", "q4k")  # q4k | q8 | int8
     if preset == "tiny":
         cfg, p_def, ctx_def, attn_def = tiny, 128, tiny.n_ctx, "xla"
     elif preset == "llama3-8b-8k":
@@ -337,13 +361,15 @@ def child_main() -> None:
     from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import (
         probe_flash_attention,
         probe_fused_q4k,
+        probe_fused_q8,
     )
 
     fallbacks = {}
-    if wfmt == "q4k":
-        err = probe_fused_q4k()
+    if wfmt in ("q4k", "q8"):
+        err = (probe_fused_q4k if wfmt == "q4k" else probe_fused_q8)()
         if err is not None:
-            fallbacks["fmt_fallback"] = f"fused Q4_K kernel: {err}"[:300]
+            fallbacks["fmt_fallback"] = (
+                f"fused {wfmt.upper()} kernel: {err}"[:300])
             print(f"bench: {fallbacks['fmt_fallback']}; using int8",
                   file=sys.stderr, flush=True)
             wfmt = "int8"
@@ -357,9 +383,11 @@ def child_main() -> None:
 
     t0 = time.time()
     params = synth_params_device(cfg, fmt=wfmt)
-    # label honesty: report q4k only if any tensor actually got the layout
-    if wfmt == "q4k" and not any(
-            isinstance(v, dict) and "qs" in v
+    # label honesty: report the fused format only if any tensor actually
+    # got the layout (tiny shapes fall back to int8)
+    fused_key = {"q4k": "qs", "q8": "q8"}.get(wfmt)
+    if fused_key is not None and not any(
+            isinstance(v, dict) and fused_key in v
             for v in [*params["layers"].values(), params["output"]]):
         wfmt = "int8"
     # sync: reduce EVERY leaf to a scalar and fetch it (block_until_ready is

@@ -70,13 +70,16 @@ def main() -> None:
         n_merges = 280_000
 
     dev = jax.devices()[0]
-    if wfmt == "q4k":
-        from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import probe_fused_q4k
+    if wfmt in ("q4k", "q8"):
+        from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import (
+            probe_fused_q4k,
+            probe_fused_q8,
+        )
 
-        err = probe_fused_q4k()
+        err = (probe_fused_q4k if wfmt == "q4k" else probe_fused_q8)()
         if err is not None:
-            print(f"bench_server: fused Q4_K probe failed ({err}); int8",
-                  file=sys.stderr, flush=True)
+            print(f"bench_server: fused {wfmt.upper()} probe failed "
+                  f"({err}); int8", file=sys.stderr, flush=True)
             wfmt = "int8"
     tokens, merges, types = synth_bpe_vocab(n_merges=n_merges)
     cfg = dataclasses.replace(cfg, vocab_size=len(tokens))
@@ -84,8 +87,9 @@ def main() -> None:
                        bos_id=tokens.index("<|begin_of_text|>"),
                        eos_id=tokens.index("<|eot_id|>"))
     params = synth_params_device(cfg, fmt=wfmt)
-    if wfmt == "q4k" and not any(
-            isinstance(v, dict) and "qs" in v
+    fused_key = {"q4k": "qs", "q8": "q8"}.get(wfmt)
+    if fused_key is not None and not any(
+            isinstance(v, dict) and fused_key in v
             for v in [*params["layers"].values(), params["output"]]):
         wfmt = "int8"  # label honesty: tiny shapes fall back
     batch = int(os.environ.get("LFKT_BENCH_BATCH", "1"))
