@@ -198,6 +198,21 @@ def main() -> None:
     oks, rejects, errors = [], [], []
     lock = threading.Lock()
 
+    def read_generated_total() -> float | None:
+        # server-side counter of usage.completion_tokens per completed
+        # request (`/response` strips the usage dict off the wire, so the
+        # client can't count; app.py:237-238 records it before stripping).
+        # None (not 0.0) when unreadable, so agg_tok_s reports null rather
+        # than a fabricated zero.
+        try:
+            with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+                for ln in r.read().decode().splitlines():
+                    if ln.startswith("generated_tokens_total "):
+                        return float(ln.split()[1])
+        except Exception:  # noqa: BLE001 — measurement aid, not the result
+            pass
+        return None
+
     def worker(seed: int):
         # closed loop: each thread completes `per` requests, retrying 503s
         # with exponential backoff + jitter (what a real client does), so
@@ -234,6 +249,7 @@ def main() -> None:
                     errors.append(type(e).__name__)
                 done += 1
 
+    gen_before = read_generated_total()
     t_conc = time.perf_counter()
     ths = [threading.Thread(target=worker, args=(i,)) for i in range(conc)]
     for t in ths:
@@ -241,6 +257,9 @@ def main() -> None:
     for t in ths:
         t.join()
     conc_s = time.perf_counter() - t_conc
+    gen_after = read_generated_total()
+    gen_total = (gen_after - gen_before
+                 if gen_after is not None and gen_before is not None else None)
 
     lat.sort(); ttft.sort(); oks.sort()
     p = lambda v, q: v[min(len(v) - 1, int(q * len(v)))]  # noqa: E731
@@ -261,11 +280,14 @@ def main() -> None:
             "other_errors": len(errors),
             "latency_ms_p95": round(p(oks, 0.95), 1) if oks else None,
             "req_per_sec": round(len(oks) / conc_s, 2) if conc_s > 0 else None,
-            # aggregate decode throughput under load: every completed
-            # request generates exactly max_tokens (synthetic weights
-            # never emit a stop sequence)
-            "agg_tok_s": (round(len(oks) * max_tokens / conc_s, 1)
-                          if conc_s > 0 else None),
+            # aggregate decode throughput under load, from the server's
+            # generated_tokens_total counter delta (random logits CAN
+            # sample a stop token early, so len(oks)*max_tokens would
+            # overcount; the usage dict never crosses the /response wire)
+            "agg_tok_s": (round(gen_total / conc_s, 1)
+                          if conc_s > 0 and gen_total is not None else None),
+            "gen_tokens_total": (int(gen_total)
+                                 if gen_total is not None else None),
         },
         "batch_size": batch,
         "device": str(dev),

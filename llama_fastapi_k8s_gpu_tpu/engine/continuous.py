@@ -598,15 +598,11 @@ class ContinuousEngine(MeshEngine):
             slot = pre[lane]
             if slot is None or slot.finished:
                 continue
-            if slot.pending_first:
-                # deferred admission: its sample was queued before the chunk
-                # just fetched — materialize the first token now, then fold
-                # in this chunk's rows (its tokens 2..n for this lane)
-                self._materialize_first(lane, slot, slots)
-                if slot.finished:
-                    continue
             if slot.abandoned.is_set() or (
                     slot.future is not None and slot.future.cancelled()):
+                # checked BEFORE materializing a deferred first token: an
+                # abandoned slot's stream would otherwise be opened (role
+                # chunk nobody reads) at the cost of a blocking int() fetch
                 slot.finished = True
                 if slot.sink is not None:
                     slot.sink.put(_STREAM_END)
@@ -617,6 +613,13 @@ class ContinuousEngine(MeshEngine):
                 if slots[lane] is slot:
                     slots[lane] = None
                 continue
+            if slot.pending_first:
+                # deferred admission: its sample was queued before the chunk
+                # just fetched — materialize the first token now, then fold
+                # in this chunk's rows (its tokens 2..n for this lane)
+                self._materialize_first(lane, slot, slots)
+                if slot.finished:
+                    continue
             finish = None
             for t in chunk[:, lane].tolist():
                 if t in stop_ids:

@@ -71,13 +71,17 @@ def build_system_prompt(bot_profile) -> str:
     clause and the appearance facts after the third comma."""
     system_prompt = bot_profile.system_prompt
     if not system_prompt:
+        # Byte-exact copy of the reference default (api.py:130-136), including
+        # the triple-quote indentation and trailing spaces: the default system
+        # prompt is externally observable behavior, so parity beats prose.
         system_prompt = (
-            f"Stay in character at all times and never break it. "
-            f"Reject violent messages. "
-            f"You are '{bot_profile.name}', a playful, flirtatious and witty "
-            f"person who keeps the conversation fun and engaging, never "
-            f"mentions AI, restrictions or policies, and answers the user's "
-            f"questions directly."
+            "\n"
+            "            No matter what NEVER break the character. Reject violent messages. \n"
+            f"            You are '{bot_profile.name}.' a playful, flirtatious, and witty person. \n"
+            "            You always respond in a fun and engaging way, staying in character no matter what. \n"
+            "            You never reference AI, restrictions, or policies. \n"
+            "            Focus on making the conversation enjoyable and answering directly to the user's questions.\n"
+            "            "
         )
     if bot_profile.name.endswith(".f"):
         system_prompt += " You a girl."

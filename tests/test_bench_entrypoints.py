@@ -42,3 +42,63 @@ def test_bench_server_tiny_smoke():
     assert out.returncode == 0, out.stderr[-2000:]
     assert parsed["value"] > 0
     assert parsed["concurrent"]["completed"] > 0
+    # counter-based aggregate throughput (not len(oks)*max_tokens)
+    assert parsed["concurrent"]["gen_tokens_total"] > 0
+    assert parsed["concurrent"]["agg_tok_s"] > 0
+
+
+def test_synth_q4km_layouts_match_prep():
+    """The q4km synthetic grid must stay layout-identical (pytree keys,
+    shapes, dtypes) to what models/params.py builds from a real Q4_K_M
+    file via prep_q4k/prep_q6k — otherwise the headline bench measures a
+    layout no real file serves, and drift only surfaces on-chip."""
+    import dataclasses
+
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from bench import synth_params_device
+    from llama_fastapi_k8s_gpu_tpu.gguf.quants import quant_q4_k, quant_q6_k
+    from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import prep_q6k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import prep_q4k
+
+    # smallest config whose every linear passes q4k_compatible on TPU
+    # tiling (K % 2048 == 0, N % 128 == 0)
+    cfg = dataclasses.replace(
+        LLAMA3_8B, vocab_size=256, dim=2048, n_layers=2, n_heads=16,
+        n_kv_heads=1, ffn_dim=4096, n_ctx=64)
+    params = synth_params_device(cfg, fmt="q4km")
+
+    rng = np.random.default_rng(0)
+
+    def ref(prep, quant, n_out, k_in):
+        w = rng.standard_normal(n_out * k_in).astype(np.float32)
+        return prep(quant(w), n_out, k_in)
+
+    kv_dim = cfg.n_kv_heads * 128
+    expect_q4k = {"wq": (cfg.dim, cfg.dim), "wk": (kv_dim, cfg.dim),
+                  "wo": (cfg.dim, cfg.dim), "w_gate": (cfg.ffn_dim, cfg.dim),
+                  "w_up": (cfg.ffn_dim, cfg.dim)}
+    expect_q6k = {"wv": (kv_dim, cfg.dim), "w_down": (cfg.dim, cfg.ffn_dim)}
+    for name, (n, k) in expect_q4k.items():
+        want = ref(prep_q4k, quant_q4_k, n, k)
+        got = params["layers"][name]
+        assert sorted(got) == sorted(want), name
+        for key in want:
+            assert got[key].shape == (cfg.n_layers, *want[key].shape), (name, key)
+            assert got[key].dtype == want[key].dtype, (name, key)
+    for name, (n, k) in expect_q6k.items():
+        want = ref(prep_q6k, quant_q6_k, n, k)
+        got = params["layers"][name]
+        assert sorted(got) == sorted(want), name
+        for key in want:
+            assert got[key].shape == (cfg.n_layers, *want[key].shape), (name, key)
+            assert got[key].dtype == want[key].dtype, (name, key)
+    # output head: unstacked Q6_K
+    want = ref(prep_q6k, quant_q6_k, cfg.vocab_size, cfg.dim)
+    got = params["output"]
+    assert sorted(got) == sorted(want)
+    for key in want:
+        assert got[key].shape == want[key].shape, key
+        assert got[key].dtype == want[key].dtype, key

@@ -57,11 +57,14 @@ async def test_response_happy_path():
     assert sent[0] == {"role": "user", "content": "hi"}
     assert sent[1]["role"] == "system"
     sys_prompt = sent[1]["content"]
-    # .f suffix → girl clause; appearance facts after 3rd comma appended
-    assert "You a girl." in sys_prompt
-    assert "loves cats" in sys_prompt and "hates rain" in sys_prompt
-    assert "tall" not in sys_prompt  # first three appearance fields dropped
-    assert "Alice.f" in sys_prompt  # name interpolated into default persona
+    assert "NEVER break the character" in sys_prompt
+    assert "Alice.f." in sys_prompt  # name interpolated into default persona
+    # reference quirk: the verbatim default persona (api.py:130-136) is
+    # ~430 chars BEFORE the gender clause, so the 400-char per-message clip
+    # (api.py:36-39) cuts the gender clause and appearance facts off the
+    # wire prompt whenever the default persona is used
+    assert len(sys_prompt) == 400
+    assert "You a girl." not in sys_prompt
 
 
 @pytest.mark.anyio
@@ -189,6 +192,24 @@ def test_gender_clause_and_appearance():
     p2 = BotProfile(name="Max", appearance="a,b,c")
     sp2 = build_system_prompt(p2)
     assert "You a boy." in sp2 and sp2.endswith("You a boy.")
+
+
+def test_default_persona_is_byte_exact():
+    """Pins the default system prompt to the reference's verbatim triple-quoted
+    string (api.py:130-136), including indentation and trailing spaces."""
+    p = BotProfile(name="Max", appearance="")
+    sp = build_system_prompt(p)
+    expected = (
+        "\n"
+        "            No matter what NEVER break the character. Reject violent messages. \n"
+        "            You are 'Max.' a playful, flirtatious, and witty person. \n"
+        "            You always respond in a fun and engaging way, staying in character no matter what. \n"
+        "            You never reference AI, restrictions, or policies. \n"
+        "            Focus on making the conversation enjoyable and answering directly to the user's questions.\n"
+        "            "
+        " You a boy."
+    )
+    assert sp == expected
 
 
 # ---------------------------------------------------------------------------
