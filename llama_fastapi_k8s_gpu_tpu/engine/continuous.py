@@ -124,11 +124,17 @@ class ContinuousEngine(MeshEngine):
     """
 
     def __init__(self, model_path: str | None, *, max_top_k: int = 64,
-                 prefill_chunk: int = 256, **kw):
+                 prefill_chunk: int = 256, adm_budget: int = 512, **kw):
         super().__init__(model_path, **kw)
         #: admission prompt-slice size: smaller → tighter bound on how long
         #: live lanes' decode waits behind an admission's device work
         self._prefill_chunk = max(1, prefill_chunk)
+        #: prefill-token budget per scheduler iteration: with short prompts
+        #: several COMPLETE admissions fit one iteration (round-3 limit was
+        #: exactly one, which left freed lanes idle under churn — lanes
+        #: drain at up to B/n_chunks per iteration but refill at 1); a
+        #: long prompt still yields after one slice (bounded decode stall)
+        self._adm_budget = max(self._prefill_chunk, adm_budget)
         self._adm: dict | None = None   # in-flight chunked admission
         self._scratch_cache = init_cache(self.cfg)
         base_st = sampling_tensors(SamplingParams())
@@ -534,26 +540,29 @@ class ContinuousEngine(MeshEngine):
             else:
                 slots[lane] = slot
 
-    def _admit_step(self, slots: list) -> bool:
+    def _admit_step(self, slots: list) -> int | None:
         """One unit of admission progress: begin the next queued item (and
         dispatch its first prefill slice), or dispatch the in-flight
         admission's next slice — finishing it (sample + lane write) when the
-        last slice lands.  Returns False when there is nothing to do."""
+        last slice lands.  Returns the number of prefill tokens dispatched
+        (0 for bookkeeping-only progress), or None when there is nothing
+        to do."""
         if self._adm is None:
             if not any(s is None for s in slots):
-                return False                     # no free lane to admit into
+                return None                     # no free lane to admit into
             try:
                 item = self._pending.get_nowait()
             except queue_mod.Empty:
-                return False
+                return None
             self._adm = self._begin_admission(item)
             if self._adm is None:
-                return True                      # item resolved/skipped: progress
+                return 0                        # item resolved/skipped: progress
         adm = self._adm
         if adm["item"].abandoned.is_set():       # caller gave up mid-prefill
             self._resolve_skipped(adm["item"])
             self._adm = None
-            return True
+            return 0
+        off_before = adm["offset"]
         try:
             self._dispatch_prefill_chunk(adm)
         except Exception as e:  # noqa: BLE001 — per-request isolation: a
@@ -563,7 +572,7 @@ class ContinuousEngine(MeshEngine):
                 item.future.set_exception(e)
             elif item.sink is not None:
                 item.sink.put(e)
-            return True
+            return 0
         # stop at the slice containing the last REAL token: pure-padding
         # slices would only write cache garbage decode overwrites anyway,
         # while costing one scheduler iteration of TTFT each under load
@@ -571,7 +580,28 @@ class ContinuousEngine(MeshEngine):
             self._adm = None
             lane = next(i for i, s in enumerate(slots) if s is None)
             self._finish_admission(adm, lane, slots)
-        return True
+        return adm["offset"] - off_before
+
+    def _admit_round(self, slots: list) -> bool:
+        """Admissions for ONE scheduler iteration: complete admissions are
+        taken until the per-iteration prefill-token budget runs out, a
+        partial (long-prompt) admission yields, or lanes/queue are
+        exhausted.  At most one admission is ever mid-prompt, so prefill
+        slices of different requests never interleave on the device queue
+        and the single scratch cache stays safe: a completed admission's
+        lane write is dispatched BEFORE the next admission's first slice.
+        Returns True if any progress was made."""
+        budget = self._adm_budget
+        progressed = False
+        while budget > 0:
+            spent = self._admit_step(slots)
+            if spent is None:
+                break
+            progressed = True
+            budget -= spent
+            if self._adm is not None:
+                break   # long admission yielded mid-prompt: bounded stall
+        return progressed
 
     def scheduler_stats(self) -> dict:
         """Point-in-time scheduler occupancy for ``/metrics`` (lanes_live,
@@ -650,7 +680,7 @@ class ContinuousEngine(MeshEngine):
                     # drive the machine at full speed until a lane fills
                     progressed = False
                     while not any(s is not None for s in slots):
-                        if not self._admit_step(slots):
+                        if self._admit_step(slots) is None:
                             break
                         progressed = True
                     if not any(s is not None for s in slots):
@@ -679,14 +709,15 @@ class ContinuousEngine(MeshEngine):
                 else:
                     dispatched = None
 
-                # ---- overlap: at most ONE admission prefill SLICE per chunk
-                # runs while the chunk executes; the lane write queues after
-                # the dispatched chunks, and an admitted request's tokens
-                # start with the chunk dispatched NEXT iteration (pre[]
-                # snapshots who gets each chunk's rows).  Chunked prefill
-                # bounds the per-iteration stall to one slice even for a
-                # full-bucket prompt.
-                self._admit_step(slots)
+                # ---- overlap: admission prefills run while the chunk
+                # executes, up to the per-iteration token budget (several
+                # complete short admissions, or one slice of a long one);
+                # each lane write queues after the dispatched chunks, and an
+                # admitted request's tokens start with the chunk dispatched
+                # NEXT iteration (pre[] snapshots who gets each chunk's
+                # rows).  Chunked prefill bounds the per-iteration stall to
+                # the budget even for full-bucket prompts.
+                self._admit_round(slots)
 
                 # ---- harvest the PREVIOUS chunk (fetch blocks only until
                 # that chunk is done; the one dispatched above keeps the

@@ -279,7 +279,19 @@ class Engine:
             else:
                 logger.error("fused %s kernel failed its compile probe; "
                              "its tensors load as int8 instead: %s", name, err)
-        if not passed and probed:
+        if not probed:
+            # No fused-eligible quantized tensors in the file at all — the
+            # F16 (or BF16) GGUF variant of BASELINE config #3.  Decision:
+            # serve int8.  8B bf16 weights are ~16 GB and cannot share
+            # v5e's 16 GB HBM with the KV cache; per-channel int8 requant
+            # (on device, load_params) halves bytes/token and runs the MXU
+            # int8 path at ~85% of its bandwidth roofline (docs/PERF.md).
+            logger.info(
+                "no fused-eligible quantized tensors in the file; serving "
+                "weight_format=int8 (on-device per-channel requant — the "
+                "documented decision for F16/BF16 GGUFs, docs/PERF.md)")
+            return "int8", None
+        if not passed:
             return "int8", None
         return "q4k", frozenset(passed)
 

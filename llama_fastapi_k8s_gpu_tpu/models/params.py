@@ -84,25 +84,39 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     make = _LINEAR_MAKERS[base_fmt]
 
     def _fused_names() -> dict[str, object]:
-        """Linear positions where ALL layers share one fused-kernel-eligible
-        quantized type (Q4_K/Q5_K/Q6_K/Q8_0 — Q4_K_M/Q5_K_M files mix them;
-        a name whose layers mix types falls back to int8 because stacked
-        scan params need one layout per name)."""
+        """Linear positions that can serve a fused kernel, mapped to the
+        ONE GGML type the whole (L, ...) stack will use — stacked scan
+        params need a single layout per name.
+
+        Uniform names use their file type.  Names mixing the K-quants
+        (Q4_K/Q5_K/Q6_K — llama.cpp's Q4_K_M ``use_more_bits`` recipe puts
+        e.g. half the ffn_down layers on Q6_K and half on Q4_K) are
+        PROMOTED to the highest K-quant present: the minority layers are
+        requantized onto the finer grid (16-element sub-block scales —
+        strictly finer than the int8 per-row fallback this replaces) and
+        the whole name stays on the fused decode path at ≤0.88 B/weight."""
         from ..gguf.constants import GGMLType
         from ..ops.pallas.qmatmul import q4k_compatible
 
         fusable = tuple(fused_types) if fused_types is not None \
             else (GGMLType.Q4_K, GGMLType.Q5_K, GGMLType.Q6_K, GGMLType.Q8_0)
+        k_rank = {GGMLType.Q4_K: 0, GGMLType.Q5_K: 1, GGMLType.Q6_K: 2}
         names = ["attn_q", "attn_k", "attn_v", "attn_output",
                  "ffn_gate", "ffn_up", "ffn_down"]
         ok: dict[str, object] = {}
         for n in names:
             ts = [gf[f"blk.{i}.{n}.weight"] for i in range(cfg.n_layers)]
-            t0 = ts[0].ggml_type
-            if t0 in fusable and all(
-                    t.ggml_type == t0 and q4k_compatible(*reversed(t.shape))
-                    for t in ts):
-                ok[n] = t0
+            if not all(q4k_compatible(*reversed(t.shape)) for t in ts):
+                continue
+            types = {t.ggml_type for t in ts}
+            if len(types) == 1:
+                t0 = ts[0].ggml_type
+                if t0 in fusable:
+                    ok[n] = t0
+            elif types <= set(k_rank):
+                target = max(types, key=k_rank.get)
+                if target in fusable:
+                    ok[n] = target
         t = gf.tensors.get("output.weight")
         if t is not None and t.ggml_type in fusable \
                 and q4k_compatible(*reversed(t.shape)):
@@ -121,10 +135,19 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
             from ..ops.pallas.qmatmul import prep_q4k
 
             t = gf[name]
+            target = fused_names[short]
+            if t.ggml_type != target:
+                # K-quant promotion (mixed-type name): dequantize and
+                # requantize onto the name's chosen finer grid
+                from ..ops.linear import make_linear_q5k, make_linear_q6k
+
+                maker = {GGMLType.Q5_K: make_linear_q5k,
+                         GGMLType.Q6_K: make_linear_q6k}[target]
+                return maker(t.astype_f32())
             n_out, k_in = tuple(reversed(t.shape))
             prep = {GGMLType.Q4_K: prep_q4k, GGMLType.Q5_K: prep_q5k,
                     GGMLType.Q6_K: prep_q6k,
-                    GGMLType.Q8_0: prep_q8_0}[fused_names[short]]
+                    GGMLType.Q8_0: prep_q8_0}[target]
             return prep(np.asarray(t.raw()), n_out, k_in)
         if on_device:
             w = _tensor_to_device(gf[name])

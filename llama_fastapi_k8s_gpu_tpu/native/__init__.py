@@ -104,6 +104,21 @@ def _bind(so_path: str) -> ctypes.CDLL | None:
     lib.lfkt_dequant.restype = ctypes.c_int
     lib.lfkt_supported.argtypes = [ctypes.c_int]
     lib.lfkt_supported.restype = ctypes.c_int
+    try:
+        lib.lfkt_prep_q4k.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.lfkt_prep_q4k.restype = ctypes.c_int
+        lib.lfkt_prep_q6k.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.lfkt_prep_q6k.restype = ctypes.c_int
+    except AttributeError:
+        # stale cached .so predating the packers: dequant still works, the
+        # prep entry points just fall back to numpy
+        pass
     return lib
 
 
@@ -196,3 +211,57 @@ def native_dequantize(buf: np.ndarray, ggml_type: int, n_elements: int,
         logger.warning("native dequant rc=%d for type %d; numpy fallback", rc, ggml_type)
         return None
     return out
+
+
+def _bf16_view(u16: np.ndarray) -> np.ndarray:
+    import ml_dtypes
+
+    return u16.view(ml_dtypes.bfloat16)
+
+
+def native_prep_q4k(raw: np.ndarray, n_out: int, k_in: int,
+                    n_threads: int = 0) -> dict | None:
+    """Raw Q4_K block bytes -> {"qs" int8 (n,k/2), "sm" bf16 (k/2048,n,128)}
+    numpy arrays in the fused-kernel layout (ops/pallas/qmatmul.py), packed
+    by the threaded C++ path; None -> caller uses the numpy packer."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lfkt_prep_q4k"):
+        return None
+    src = np.ascontiguousarray(raw, dtype=np.uint8).reshape(-1)
+    if src.size < (n_out * k_in // 256) * 144:
+        return None
+    qs = np.empty((n_out, k_in // 2), dtype=np.int8)
+    sm = np.empty((k_in // 2048, n_out, 128), dtype=np.uint16)
+    rc = lib.lfkt_prep_q4k(
+        src.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n_out), ctypes.c_int64(k_in),
+        qs.ctypes.data_as(ctypes.c_void_p), sm.ctypes.data_as(ctypes.c_void_p),
+        int(n_threads))
+    if rc != 0:
+        logger.warning("native prep_q4k rc=%d; numpy fallback", rc)
+        return None
+    return {"qs": qs, "sm": _bf16_view(sm)}
+
+
+def native_prep_q6k(raw: np.ndarray, n_out: int, k_in: int,
+                    n_threads: int = 0) -> dict | None:
+    """Raw Q6_K block bytes -> {"q4", "q2", "sm6"} numpy arrays in the fused
+    layout (ops/pallas/q6matmul.py); None -> numpy packer."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lfkt_prep_q6k"):
+        return None
+    src = np.ascontiguousarray(raw, dtype=np.uint8).reshape(-1)
+    if src.size < (n_out * k_in // 256) * 210:
+        return None
+    q4 = np.empty((n_out, k_in // 2), dtype=np.int8)
+    q2 = np.empty((n_out, k_in // 4), dtype=np.int8)
+    sm6 = np.empty((k_in // 2048, n_out, 128), dtype=np.uint16)
+    rc = lib.lfkt_prep_q6k(
+        src.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n_out), ctypes.c_int64(k_in),
+        q4.ctypes.data_as(ctypes.c_void_p), q2.ctypes.data_as(ctypes.c_void_p),
+        sm6.ctypes.data_as(ctypes.c_void_p), int(n_threads))
+    if rc != 0:
+        logger.warning("native prep_q6k rc=%d; numpy fallback", rc)
+        return None
+    return {"q4": q4, "q2": q2, "sm6": _bf16_view(sm6)}

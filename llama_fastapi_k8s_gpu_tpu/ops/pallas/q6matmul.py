@@ -76,10 +76,20 @@ q6k_compatible = q4k_compatible  # same divisibility classes
 
 def prep_q6k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
     """Raw Q6_K block bytes (row-major, ``n_out`` rows of ``k_in`` elements)
-    → the kernel layout dict {"q4", "q2", "sm6"}."""
+    → the kernel layout dict {"q4", "q2", "sm6"}.
+
+    Dispatches to the threaded C++ packer (native/src/gguf_dequant.cpp,
+    bit-identical planes — tests/test_native.py) when available; the numpy
+    chain below is the reference implementation and the fallback."""
     if not q6k_compatible(n_out, k_in):
         raise ValueError(f"({n_out}, {k_in}) not fused-Q6_K compatible "
                          f"(need K%{TK}==0, N%128==0)")
+    from ...native import native_prep_q6k
+
+    nat = native_prep_q6k(raw, n_out, k_in)
+    if nat is not None:
+        return {"q4": jnp.asarray(nat["q4"]), "q2": jnp.asarray(nat["q2"]),
+                "sm6": jnp.asarray(nat["sm6"])}
     bs = GGML_BLOCK_SIZES[GGMLType.Q6_K][1]           # 210
     nb = k_in // QK_K
     kt = k_in // TK

@@ -100,10 +100,19 @@ def q4k_compatible(n_out: int, k_in: int, for_tpu: bool | None = None) -> bool:
 
 def prep_q4k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
     """Raw Q4_K block bytes (row-major, ``n_out`` rows of ``k_in`` elements)
-    → the kernel layout dict {"qs", "sm"}."""
+    → the kernel layout dict {"qs", "sm"}.
+
+    Dispatches to the threaded C++ packer (native/src/gguf_dequant.cpp,
+    bit-identical planes — tests/test_native.py) when available; the numpy
+    chain below is the reference implementation and the fallback."""
     if not q4k_compatible(n_out, k_in):
         raise ValueError(f"({n_out}, {k_in}) not fused-Q4_K compatible "
                          f"(need K%{TK}==0, N%128==0)")
+    from ...native import native_prep_q4k
+
+    nat = native_prep_q4k(raw, n_out, k_in)
+    if nat is not None:
+        return {"qs": jnp.asarray(nat["qs"]), "sm": jnp.asarray(nat["sm"])}
     bs = GGML_BLOCK_SIZES[GGMLType.Q4_K][1]           # 144
     nb = k_in // QK_K
     ktiles = k_in // TK

@@ -638,7 +638,8 @@ def _default_engine_factory(settings: Settings):
                 eng = ContinuousEngine(
                     settings.model_path, tp=settings.mesh_tp,
                     batch_size=settings.batch_size,
-                    prefill_chunk=settings.prefill_chunk, **kw)
+                    prefill_chunk=settings.prefill_chunk,
+                    adm_budget=settings.adm_budget, **kw)
             else:
                 eng = MeshEngine(settings.model_path, tp=settings.mesh_tp,
                                  batch_size=settings.batch_size, **kw)

@@ -64,6 +64,9 @@ class Settings:
     spec_decode: str = "off"        # off | lookup — prompt-lookup speculative
     spec_draft: int = 8             # draft tokens per verify step
     prefill_chunk: int = 256        # continuous-scheduler admission slice size
+    adm_budget: int = 512           # admission prefill tokens per scheduler
+    #                                 iteration (several short admissions,
+    #                                 or slices of one long prompt)
     # >1 switches the server to mesh-batched serving — the v5e-4
     # "concurrent /response load" config.  scheduler picks the flavor:
     #   cycle      — MeshEngine: coalesce up to batch_size queued requests
@@ -112,6 +115,7 @@ def get_settings() -> Settings:
         spec_decode=_env("LFKT_SPEC_DECODE", Settings.spec_decode),
         spec_draft=_env("LFKT_SPEC_DRAFT", Settings.spec_draft, int),
         prefill_chunk=_env("LFKT_PREFILL_CHUNK", Settings.prefill_chunk, int),
+        adm_budget=_env("LFKT_ADM_BUDGET", Settings.adm_budget, int),
         batch_size=_env("LFKT_BATCH_SIZE", Settings.batch_size, int),
         scheduler=_env("LFKT_SCHEDULER", Settings.scheduler),
         mesh_tp=_env("LFKT_MESH_TP", Settings.mesh_tp, int),

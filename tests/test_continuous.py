@@ -50,6 +50,26 @@ def test_more_requests_than_lanes(cengine):
     assert all(o["usage"]["completion_tokens"] >= 1 for o in outs)
 
 
+def test_concurrent_admissions_in_one_round_are_correct(cengine, tmp_path):
+    """Several COMPLETE admissions can now land in one scheduler iteration
+    (_admit_round budget).  Every request in a 12-wide wave of distinct
+    short prompts must produce exactly the serial engine's greedy output —
+    pinning that back-to-back admissions through the shared scratch cache
+    never bleed into each other."""
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    serial = Engine(path, n_ctx=128, decode_chunk=4, max_gen_tokens=16,
+                    prefill_buckets=(32, 64, 128))
+    prompts = [[{"role": "user", "content": f"wave {i} " * (1 + i % 4)}]
+               for i in range(12)]
+    want = [serial.create_chat_completion(p, temperature=0.0, max_tokens=6)
+            ["choices"][0]["message"]["content"] for p in prompts]
+    futs = [cengine.submit(p, temperature=0.0, max_tokens=6) for p in prompts]
+    got = [f.result(timeout=120)["choices"][0]["message"]["content"]
+           for f in futs]
+    assert got == want
+
+
 def test_submissions_are_deterministic_under_concurrency(cengine):
     """A request's greedy output must not depend on lane neighbors."""
     solo = cengine.create_chat_completion(MSGS, temperature=0.0, max_tokens=8)
@@ -320,6 +340,12 @@ def test_decode_progresses_during_admission_wave(cengine):
         return orig(adm)
 
     cengine._dispatch_prefill_chunk = slow_chunk
+    # pin the per-iteration admission budget to ONE slice for this test:
+    # the decode-overlap bound being verified is per-admission; the default
+    # budget intentionally admits several short requests per iteration
+    # (test_concurrent_admissions_in_one_round_are_correct covers that)
+    budget_saved = cengine._adm_budget
+    cengine._adm_budget = 1
     try:
         stream = cengine.submit_stream(
             [{"role": "user", "content": "stream me"}],
@@ -345,6 +371,7 @@ def test_decode_progresses_during_admission_wave(cengine):
         assert max(gaps) < (n_wave - 1) * delay, gaps
     finally:
         cengine._dispatch_prefill_chunk = orig
+        cengine._adm_budget = budget_saved
 
 
 def test_chunked_prefill_bounds_stall_per_slice(tmp_path):

@@ -96,6 +96,26 @@ def test_q4k_model_loads(tmp_path):
     assert isinstance(out["choices"][0]["message"]["content"], str)
 
 
+def test_f16_file_serves_int8_decision():
+    """BASELINE config #3's F16 GGUF variant: a file with no fused-eligible
+    quantized tensors must resolve EXPLICITLY to int8 serving (8B bf16 can't
+    share 16 GB HBM with the KV cache; docs/PERF.md documents the
+    decision) — not to a 'q4k' label that quietly loads everything int8."""
+    fmt, fused = Engine._probe_fused_format({GGMLType.F16, GGMLType.F32})
+    assert fmt == "int8" and fused is None
+
+
+def test_f16_majority_file_loads_and_serves(tmp_path):
+    """End-to-end: an F16-weights GGUF loads through the int8 requant path
+    and serves a completion."""
+    path = str(tmp_path / "f16.gguf")
+    write_tiny_llama_gguf(path, quant=GGMLType.F16, ffn_quant=GGMLType.F16)
+    eng = Engine(path, n_ctx=128, decode_chunk=4, max_gen_tokens=8,
+                 prefill_buckets=(32, 64, 128), weight_format="int8")
+    out = eng.create_chat_completion(MSGS, temperature=0.0, max_tokens=4)
+    assert out["usage"]["completion_tokens"] >= 1
+
+
 def test_usage_counts_against_tokenizer(engine):
     out = engine.create_chat_completion(MSGS, temperature=0.0, max_tokens=8)
     ids = engine.tokenize_messages(MSGS)

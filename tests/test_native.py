@@ -121,3 +121,68 @@ def test_dispatch_uses_native(monkeypatch):
     buf = quants.QUANT[GGMLType.Q4_K](x)
     out = quants.dequantize(buf, GGMLType.Q4_K, 256)
     assert calls.get("hit") and out.shape == (256,)
+
+
+# ---------------------------------------------------------------------------
+# fused-layout packers (prep_q4k / prep_q6k): C++ vs the numpy reference
+# ---------------------------------------------------------------------------
+
+def _numpy_prep(prep_fn, monkeypatch, module, native_name, raw, n, k):
+    """Run the in-module numpy packer with the native path disabled."""
+    monkeypatch.setattr(module, native_name, lambda *a, **kw: None)
+    return prep_fn(raw, n, k)
+
+
+@pytest.mark.parametrize("n,k", [(128, 2048), (256, 4096), (8, 2048)])
+def test_prep_q4k_bit_exact(monkeypatch, n, k):
+    from llama_fastapi_k8s_gpu_tpu.native import native_prep_q4k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas import qmatmul
+
+    rng = np.random.default_rng(n + k)
+    raw = quants.quant_q4_k(
+        (rng.standard_normal(n * k) * 0.05).astype(np.float32))
+    nat = native_prep_q4k(raw, n, k)
+    assert nat is not None
+    import llama_fastapi_k8s_gpu_tpu.native as native_mod
+    monkeypatch.setattr(native_mod, "native_prep_q4k", lambda *a, **kw: None)
+    ref = qmatmul.prep_q4k(raw, n, k)
+    assert np.array_equal(nat["qs"], np.asarray(ref["qs"]))
+    assert np.array_equal(nat["sm"].view(np.uint16),
+                          np.asarray(ref["sm"]).view(np.uint16))
+
+
+@pytest.mark.parametrize("n,k", [(128, 2048), (256, 4096), (8, 2048)])
+def test_prep_q6k_bit_exact(monkeypatch, n, k):
+    from llama_fastapi_k8s_gpu_tpu.native import native_prep_q6k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas import q6matmul
+
+    rng = np.random.default_rng(n + k + 1)
+    raw = quants.quant_q6_k(
+        (rng.standard_normal(n * k) * 0.05).astype(np.float32))
+    nat = native_prep_q6k(raw, n, k)
+    assert nat is not None
+    import llama_fastapi_k8s_gpu_tpu.native as native_mod
+    monkeypatch.setattr(native_mod, "native_prep_q6k", lambda *a, **kw: None)
+    ref = q6matmul.prep_q6k(raw, n, k)
+    for key in ("q4", "q2"):
+        assert np.array_equal(nat[key], np.asarray(ref[key])), key
+    assert np.array_equal(nat["sm6"].view(np.uint16),
+                          np.asarray(ref["sm6"]).view(np.uint16))
+
+
+def test_prep_q4k_random_bytes_bit_exact(monkeypatch):
+    """Arbitrary raw bytes (any f16 scale pattern) — not just codec output."""
+    from llama_fastapi_k8s_gpu_tpu.native import native_prep_q4k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas import qmatmul
+
+    n, k = 16, 2048
+    rng = np.random.default_rng(7)
+    raw = _random_blocks(rng, GGMLType.Q4_K, n * k // 256)
+    nat = native_prep_q4k(raw, n, k)
+    assert nat is not None
+    import llama_fastapi_k8s_gpu_tpu.native as native_mod
+    monkeypatch.setattr(native_mod, "native_prep_q4k", lambda *a, **kw: None)
+    ref = qmatmul.prep_q4k(raw, n, k)
+    assert np.array_equal(nat["qs"], np.asarray(ref["qs"]))
+    assert np.array_equal(nat["sm"].view(np.uint16),
+                          np.asarray(ref["sm"]).view(np.uint16))

@@ -1,0 +1,97 @@
+"""Mixed-K-quant name promotion (models/params.py).
+
+llama.cpp's Q4_K_M recipe (``use_more_bits``) puts roughly half the
+ffn_down layers on Q6_K and the rest on Q4_K.  Stacked-scan params need one
+layout per name, so a mixed name must be PROMOTED to the highest K-quant
+present (minority layers requantized onto the finer grid) rather than
+dropped to the int8 per-row fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from llama_fastapi_k8s_gpu_tpu.gguf import GGMLType, GGUFFile, GGUFWriter
+from llama_fastapi_k8s_gpu_tpu.gguf.quants import dequant_q4_k, quant_q4_k
+from llama_fastapi_k8s_gpu_tpu.models.params import load_params
+from llama_fastapi_k8s_gpu_tpu.testing import (
+    TINY_CFG,
+    byte_vocab_with_specials,
+    write_llama_gguf_meta,
+)
+
+# ffn_dim=2048 makes ffn_down (dim, 2048) the one fused-compatible linear
+# on the CPU interpret grid (K % 2048 == 0, N % 8 == 0)
+CFG = dataclasses.replace(TINY_CFG, ffn_dim=2048, n_layers=2)
+
+
+def _write_mixed_gguf(path: str, rng) -> np.ndarray:
+    tokens, types = byte_vocab_with_specials()
+    cfg = dataclasses.replace(CFG, vocab_size=len(tokens))
+    w = GGUFWriter(path)
+    write_llama_gguf_meta(w, cfg, tokens, types)
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    scale = cfg.dim ** -0.5
+
+    def t(name, shape, gtype):
+        w.add_tensor(name, rng.standard_normal(shape).astype(np.float32) * scale,
+                     gtype)
+
+    t("token_embd.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
+    down = rng.standard_normal((2, cfg.dim, cfg.ffn_dim)).astype(np.float32) * 0.05
+    for i in range(cfg.n_layers):
+        p = f"blk.{i}."
+        t(p + "attn_norm.weight", (cfg.dim,), GGMLType.F32)
+        t(p + "attn_q.weight", (cfg.dim, cfg.dim), GGMLType.Q8_0)
+        t(p + "attn_k.weight", (kv_dim, cfg.dim), GGMLType.Q8_0)
+        t(p + "attn_v.weight", (kv_dim, cfg.dim), GGMLType.Q8_0)
+        t(p + "attn_output.weight", (cfg.dim, cfg.dim), GGMLType.Q8_0)
+        t(p + "ffn_norm.weight", (cfg.dim,), GGMLType.F32)
+        t(p + "ffn_gate.weight", (cfg.ffn_dim, cfg.dim), GGMLType.Q8_0)
+        t(p + "ffn_up.weight", (cfg.ffn_dim, cfg.dim), GGMLType.Q8_0)
+        # the mixed name: layer 0 Q4_K, layer 1 Q6_K
+        w.add_tensor(p + "ffn_down.weight", down[i],
+                     GGMLType.Q4_K if i == 0 else GGMLType.Q6_K)
+    t("output_norm.weight", (cfg.dim,), GGMLType.F32)
+    t("output.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
+    w.write()
+    return down
+
+
+def test_mixed_kquant_name_promotes_to_q6k(tmp_path):
+    rng = np.random.default_rng(3)
+    path = os.path.join(tmp_path, "mixed.gguf")
+    down = _write_mixed_gguf(path, rng)
+    gf = GGUFFile(path)
+    params = load_params(gf, CFG, fmt="q4k", on_device=False)
+
+    wd = params["layers"]["w_down"]
+    assert sorted(wd) == ["q2", "q4", "sm6"], (
+        "mixed Q4_K/Q6_K ffn_down must promote to the fused Q6_K layout, "
+        f"got keys {sorted(wd)}")
+    L, n, half = wd["q4"].shape
+    assert (L, n, half) == (2, CFG.dim, CFG.ffn_dim // 2)
+
+    # numeric: the promoted (requantized) layer-0 matmul must match the
+    # Q4_K-dequantized original within the small Q6 regrid error; layer 1
+    # (native Q6_K) must match its own file values the same way
+    from llama_fastapi_k8s_gpu_tpu.gguf.quants import dequant_q6_k, quant_q6_k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import q6k_matmul
+
+    x = jnp.asarray(rng.standard_normal((2, CFG.ffn_dim)), jnp.bfloat16)
+    for layer, codec_ref in ((0, dequant_q4_k(quant_q4_k(down[0].reshape(-1)),
+                                              down[0].size)),
+                             (1, dequant_q6_k(quant_q6_k(down[1].reshape(-1)),
+                                              down[1].size))):
+        w_layer = {k: v[layer] for k, v in wd.items()}
+        got = np.asarray(q6k_matmul(x, w_layer, interpret=True),
+                         dtype=np.float32)
+        ref_w = codec_ref.reshape(CFG.dim, CFG.ffn_dim)
+        want = np.asarray(x, np.float32) @ ref_w.T
+        denom = np.maximum(np.abs(want).max(), 1e-6)
+        assert np.abs(got - want).max() / denom < 0.05, (
+            f"layer {layer}: promoted matmul deviates from file values")
