@@ -138,11 +138,12 @@ class Engine:
             raise ValueError(
                 f"spec_draft must be in [1, n_ctx-2], got {spec_draft}")
         self._spec_draft = spec_draft if spec_decode == "lookup" else 0
-        if self._spec_draft and type(self) is not Engine:
+        if self._spec_draft and type(self) is not Engine \
+                and not getattr(self, "_SPEC_LANES", False):
             logger.warning(
-                "spec_decode='lookup' is only served by the serial Engine; "
-                "%s serves vanilla decode (see _spec_enabled)",
-                type(self).__name__)
+                "spec_decode='lookup' is served by the serial Engine and the "
+                "continuous scheduler; %s serves vanilla decode "
+                "(see _spec_enabled)", type(self).__name__)
         self._lock = threading.Lock()
         self._base_seed = seed
         # request counter: shared by the serial path (caller thread) and the

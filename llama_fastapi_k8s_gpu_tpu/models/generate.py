@@ -103,13 +103,8 @@ def generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
     return generate_chunk(params, cfg, state, st, n_steps, top_k)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "top_k"),
-    donate_argnames=("state",),
-)
-def spec_verify_jit(params, cfg: ModelConfig, state: dict, st: dict,
-                    draft, top_k: int = 40):
+def spec_verify(params, cfg: ModelConfig, state: dict, st: dict,
+                draft, top_k: int = 40):
     """Speculative-decoding verify step (prompt-lookup drafts, engine.py).
 
     Feeds ``[state["token"], draft...]`` — D+1 tokens — through ONE forward
@@ -180,3 +175,10 @@ def spec_verify_jit(params, cfg: ModelConfig, state: dict, st: dict,
         "key": fin["key"],
     }
     return new_state, toks, fin["count"]
+
+
+spec_verify_jit = functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "top_k"),
+    donate_argnames=("state",),
+)(spec_verify)

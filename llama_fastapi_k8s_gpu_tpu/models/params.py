@@ -125,6 +125,13 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
 
     fused_names = _fused_names() if fmt == "q4k" else {}
 
+    import time as _time
+
+    # coarse load-phase attribution, logged at the end: prep (host packers /
+    # codecs incl. the raw() mmap page-ins they trigger) vs stack (jnp.stack
+    # = host->device transfer of every packed plane)
+    phase_s = {"prep": 0.0, "stack": 0.0}
+
     def lin(name: str) -> dict:
         short = name.split(".")[-2] if name.startswith("blk.") else name.split(".")[0]
         if short in fused_names:
@@ -160,6 +167,7 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
         return jnp.asarray(gf[name].astype_f32(), dtype=jnp.float32)
 
     layers = []
+    t0 = _time.time()
     for i in range(cfg.n_layers):
         p = f"blk.{i}."
         layers.append({
@@ -174,6 +182,7 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
             "w_down": lin(p + "ffn_down.weight"),
         })
         logger.debug("loaded layer %d/%d", i + 1, cfg.n_layers)
+    phase_s["prep"] = _time.time() - t0
 
     if on_device:
         emb = _tensor_to_device(gf["token_embd.weight"], jnp.bfloat16)
@@ -183,9 +192,16 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
         output = {"w": emb}
     else:
         output = lin("output.weight")
+    t0 = _time.time()
+    stacked = _stack(layers)
+    jax.block_until_ready(stacked)   # best-effort on the tunneled platform;
+    #                                  coldstart_main times load externally
+    phase_s["stack"] = _time.time() - t0
+    logger.info("load_params phases: per-layer prep+transfer %.1fs, "
+                "stack %.1fs", phase_s["prep"], phase_s["stack"])
     return {
         "tok_emb": emb,
-        "layers": _stack(layers),
+        "layers": stacked,
         "out_norm": norm("output_norm.weight"),
         "output": output,
     }

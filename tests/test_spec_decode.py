@@ -205,3 +205,69 @@ def test_spec_timings_report_acceptance(tmp_path):
     st = out["lfkt_timings"]["spec"]
     assert st["verify_steps"] + st["fallback_steps"] >= 1
     assert 0 <= st["accepted"] <= st["drafted"]
+
+
+# ---------------------------------------------------------------------------
+# continuous scheduler: per-lane drafts + batched verify (VERDICT r3 #7)
+# ---------------------------------------------------------------------------
+
+def test_continuous_spec_greedy_parity(tmp_path, monkeypatch):
+    """Spec under lanes must emit exactly the plain serial engine's greedy
+    output.  The lookup heuristic is replaced with an always-hit,
+    usually-wrong draft (last token repeated) so every round exercises the
+    real accept/reject math and the count-sliced harvest — organic n-gram
+    hits on a tiny random model are too rare to pin behavior on."""
+    from llama_fastapi_k8s_gpu_tpu.engine import ContinuousEngine
+
+    monkeypatch.setattr(
+        Engine, "_lookup_draft",
+        staticmethod(lambda history, D, max_ngram=3: [history[-1]] * D))
+
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    plain = Engine(path, n_ctx=128, decode_chunk=4, max_gen_tokens=48,
+                   prefill_buckets=(64,))
+    ceng = ContinuousEngine(path, dp=1, tp=1, batch_size=4, n_ctx=128,
+                            decode_chunk=4, max_gen_tokens=48,
+                            prefill_buckets=(64,), spec_decode="lookup",
+                            spec_draft=4)
+    try:
+        misc = [{"role": "user", "content": "alpha bravo charlie delta"}]
+        want_rep = plain.create_chat_completion(
+            MSGS, temperature=0.0, max_tokens=24)["choices"][0]["message"]["content"]
+        want_misc = plain.create_chat_completion(
+            misc, temperature=0.0, max_tokens=24)["choices"][0]["message"]["content"]
+        futs = [ceng.submit(MSGS, temperature=0.0, max_tokens=24),
+                ceng.submit(misc, temperature=0.0, max_tokens=24),
+                ceng.submit(MSGS, temperature=0.0, max_tokens=24)]
+        got = [f.result(timeout=300)["choices"][0]["message"]["content"]
+               for f in futs]
+        assert got[0] == want_rep and got[2] == want_rep
+        assert got[1] == want_misc
+        stats = ceng.scheduler_stats()
+        assert stats["spec"]["verify_steps"] >= 1
+        assert stats["spec"]["drafted"] >= 1
+    finally:
+        ceng.shutdown()
+
+
+def test_continuous_spec_stream_matches_batch(tmp_path):
+    """Streaming through the lanes under speculation returns the same text
+    as the non-streamed call."""
+    from llama_fastapi_k8s_gpu_tpu.engine import ContinuousEngine
+
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    ceng = ContinuousEngine(path, dp=1, tp=1, batch_size=2, n_ctx=128,
+                            decode_chunk=4, max_gen_tokens=48,
+                            prefill_buckets=(64,), spec_decode="lookup",
+                            spec_draft=4)
+    try:
+        batch = ceng.create_chat_completion(MSGS, temperature=0.0,
+                                            max_tokens=20)
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "")
+            for c in ceng.submit_stream(MSGS, temperature=0.0, max_tokens=20))
+        assert text == batch["choices"][0]["message"]["content"]
+    finally:
+        ceng.shutdown()
