@@ -115,6 +115,16 @@ def _bind(so_path: str) -> ctypes.CDLL | None:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
         ]
         lib.lfkt_prep_q6k.restype = ctypes.c_int
+        lib.lfkt_prep_q5k.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.lfkt_prep_q5k.restype = ctypes.c_int
+        lib.lfkt_prep_q8_0.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.lfkt_prep_q8_0.restype = ctypes.c_int
     except AttributeError:
         # stale cached .so predating the packers: dequant still works, the
         # prep entry points just fall back to numpy
@@ -265,3 +275,51 @@ def native_prep_q6k(raw: np.ndarray, n_out: int, k_in: int,
         logger.warning("native prep_q6k rc=%d; numpy fallback", rc)
         return None
     return {"q4": q4, "q2": q2, "sm6": _bf16_view(sm6)}
+
+
+def native_prep_q5k(raw: np.ndarray, n_out: int, k_in: int,
+                    n_threads: int = 0) -> dict | None:
+    """Raw Q5_K block bytes -> {"q5s", "q5h", "sm5"} numpy arrays in the
+    fused layout (ops/pallas/q5matmul.py); None -> numpy packer."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lfkt_prep_q5k"):
+        return None
+    src = np.ascontiguousarray(raw, dtype=np.uint8).reshape(-1)
+    if src.size < (n_out * k_in // 256) * 176:
+        return None
+    q5s = np.empty((n_out, k_in // 2), dtype=np.int8)
+    q5h = np.empty((n_out, k_in // 8), dtype=np.int8)
+    sm5 = np.empty((k_in // 2048, n_out, 128), dtype=np.uint16)
+    rc = lib.lfkt_prep_q5k(
+        src.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n_out), ctypes.c_int64(k_in),
+        q5s.ctypes.data_as(ctypes.c_void_p),
+        q5h.ctypes.data_as(ctypes.c_void_p),
+        sm5.ctypes.data_as(ctypes.c_void_p), int(n_threads))
+    if rc != 0:
+        logger.warning("native prep_q5k rc=%d; numpy fallback", rc)
+        return None
+    return {"q5s": q5s, "q5h": q5h, "sm5": _bf16_view(sm5)}
+
+
+def native_prep_q8_0(raw: np.ndarray, n_out: int, k_in: int,
+                     n_threads: int = 0) -> dict | None:
+    """Raw Q8_0 block bytes -> {"q8", "sm8"} numpy arrays in the fused
+    layout (ops/pallas/q8matmul.py); None -> numpy packer."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lfkt_prep_q8_0"):
+        return None
+    src = np.ascontiguousarray(raw, dtype=np.uint8).reshape(-1)
+    if src.size < (n_out * k_in // 32) * 34:
+        return None
+    q8 = np.empty((n_out, k_in), dtype=np.int8)
+    sm8 = np.empty((k_in // 2048, n_out, 128), dtype=np.uint16)
+    rc = lib.lfkt_prep_q8_0(
+        src.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n_out), ctypes.c_int64(k_in),
+        q8.ctypes.data_as(ctypes.c_void_p),
+        sm8.ctypes.data_as(ctypes.c_void_p), int(n_threads))
+    if rc != 0:
+        logger.warning("native prep_q8_0 rc=%d; numpy fallback", rc)
+        return None
+    return {"q8": q8, "sm8": _bf16_view(sm8)}

@@ -360,6 +360,78 @@ void prep_q6k_row(const uint8_t* src, int64_t n_out, int64_t k_in, int64_t row,
   }
 }
 
+// Q5_K: src blocks (176 B) -> q5s (n, k/2) int8 (Q4_K qs layout of the low
+// nibbles) + q5h (n, k/8) int8 (hi-bit bytes: tile byte b packs bit j of
+// columns j*256+b, biased -128) + sm5 (k/2048, n, 128) bf16.
+void prep_q5k_row(const uint8_t* src, int64_t n_out, int64_t k_in, int64_t row,
+                  int8_t* q5s, int8_t* q5h, uint16_t* sm5) {
+  const int64_t nb = k_in / QK_K;
+  const int64_t kt = k_in / TKQ;
+  const uint8_t* rb = src + row * nb * 176;
+  int8_t* qsrow = q5s + row * (k_in / 2);
+  int8_t* qhrow = q5h + row * (k_in / 8);
+  uint8_t nib[2048], hb[2048];
+  for (int64_t t = 0; t < kt; t++) {
+    uint16_t* smt = sm5 + (t * n_out + row) * 128;
+    for (int sb = 0; sb < 8; sb++) {
+      const uint8_t* blk = rb + (t * 8 + sb) * 176;
+      const float d = f16(blk);
+      const float dmin = f16(blk + 2);
+      uint8_t sc[8], mn[8];
+      scale_min_k4(blk + 4, sc, mn);
+      for (int j = 0; j < 8; j++) {
+        smt[sb * 8 + j] = bf16_rne(d * static_cast<float>(sc[j]));
+        smt[64 + sb * 8 + j] = bf16_rne(dmin * static_cast<float>(mn[j]));
+      }
+      const uint8_t* qh = blk + 16;
+      const uint8_t* fq = blk + 48;
+      for (int sub = 0; sub < 8; sub++) {
+        const int s = sb * 8 + sub;
+        const uint8_t* q = fq + (sub / 2) * 32;
+        for (int e = 0; e < 32; e++) {
+          const int c = e * 64 + s;
+          nib[c] = (sub & 1) ? (q[e] >> 4) : (q[e] & 0x0F);
+          hb[c] = (qh[e] >> sub) & 1;
+        }
+      }
+    }
+    int8_t* qst = qsrow + t * (TKQ / 2);
+    for (int e = 0; e < 16; e++)
+      for (int s = 0; s < 64; s++)
+        qst[e * 64 + s] = static_cast<int8_t>(
+            ((static_cast<int>(nib[(e + 16) * 64 + s]) - 8) << 4) +
+            nib[e * 64 + s]);
+    int8_t* qht = qhrow + t * (TKQ / 8);
+    for (int b = 0; b < 256; b++) {
+      int v = 0;
+      for (int j = 0; j < 8; j++) v |= static_cast<int>(hb[j * 256 + b]) << j;
+      qht[b] = static_cast<int8_t>(v - 128);
+    }
+  }
+}
+
+// Q8_0: src blocks (34 B = f16 d | 32 x i8) -> q8 (n, k) int8 element-major
+// tile columns (column c = e*64 + b) + sm8 (k/2048, n, 128) bf16 [d|d].
+void prep_q8_0_row(const uint8_t* src, int64_t n_out, int64_t k_in,
+                   int64_t row, int8_t* q8, uint16_t* sm8) {
+  const int64_t nb = k_in / 32;
+  const int64_t kt = k_in / TKQ;
+  const uint8_t* rb = src + row * nb * 34;
+  int8_t* qrow = q8 + row * k_in;
+  for (int64_t t = 0; t < kt; t++) {
+    uint16_t* smt = sm8 + (t * n_out + row) * 128;
+    int8_t* qt = qrow + t * TKQ;
+    for (int b = 0; b < 64; b++) {
+      const uint8_t* blk = rb + (t * 64 + b) * 34;
+      const uint16_t ds = bf16_rne(f16(blk));
+      smt[b] = ds;
+      smt[64 + b] = ds;
+      const int8_t* q = reinterpret_cast<const int8_t*>(blk + 2);
+      for (int e = 0; e < 32; e++) qt[e * 64 + b] = q[e];
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -395,6 +467,35 @@ int lfkt_prep_q6k(const uint8_t* src, int64_t n_out, int64_t k_in,
   run_threads(n_out, n_threads, [&](int64_t lo, int64_t hi) {
     for (int64_t r = lo; r < hi; r++)
       prep_q6k_row(src, n_out, k_in, r, q4, q2, sm6);
+  });
+  return 0;
+}
+
+int lfkt_prep_q5k(const uint8_t* src, int64_t n_out, int64_t k_in,
+                  int8_t* q5s, int8_t* q5h, uint16_t* sm5, int n_threads) {
+  if (!src || !q5s || !q5h || !sm5 || n_out <= 0 || k_in <= 0 ||
+      k_in % TKQ != 0)
+    return -2;
+  if (n_threads <= 0)
+    n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads <= 0) n_threads = 1;
+  run_threads(n_out, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; r++)
+      prep_q5k_row(src, n_out, k_in, r, q5s, q5h, sm5);
+  });
+  return 0;
+}
+
+int lfkt_prep_q8_0(const uint8_t* src, int64_t n_out, int64_t k_in,
+                   int8_t* q8, uint16_t* sm8, int n_threads) {
+  if (!src || !q8 || !sm8 || n_out <= 0 || k_in <= 0 || k_in % TKQ != 0)
+    return -2;
+  if (n_threads <= 0)
+    n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads <= 0) n_threads = 1;
+  run_threads(n_out, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; r++)
+      prep_q8_0_row(src, n_out, k_in, r, q8, sm8);
   });
   return 0;
 }

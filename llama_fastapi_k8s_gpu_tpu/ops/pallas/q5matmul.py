@@ -65,6 +65,12 @@ def prep_q5k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
     if not q5k_compatible(n_out, k_in):
         raise ValueError(f"({n_out}, {k_in}) not fused-Q5_K compatible "
                          f"(need K%{TK}==0, N%128==0)")
+    from ...native import native_prep_q5k
+
+    nat = native_prep_q5k(raw, n_out, k_in)
+    if nat is not None:
+        return {"q5s": jnp.asarray(nat["q5s"]), "q5h": jnp.asarray(nat["q5h"]),
+                "sm5": jnp.asarray(nat["sm5"])}
     bs = GGML_BLOCK_SIZES[GGMLType.Q5_K][1]           # 176
     nb = k_in // QK_K
     kt = k_in // TK

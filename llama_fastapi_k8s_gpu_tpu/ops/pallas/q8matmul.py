@@ -58,6 +58,11 @@ def prep_q8_0(raw: np.ndarray, n_out: int, k_in: int) -> dict:
     if not q8_compatible(n_out, k_in):
         raise ValueError(f"({n_out}, {k_in}) not fused-Q8_0 compatible "
                          f"(need K%{TK}==0, N%128==0)")
+    from ...native import native_prep_q8_0
+
+    nat = native_prep_q8_0(raw, n_out, k_in)
+    if nat is not None:
+        return {"q8": jnp.asarray(nat["q8"]), "sm8": jnp.asarray(nat["sm8"])}
     bs = GGML_BLOCK_SIZES[GGMLType.Q8_0][1]           # 34
     nb = k_in // 32
     kt = k_in // TK

@@ -124,65 +124,56 @@ def test_dispatch_uses_native(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
-# fused-layout packers (prep_q4k / prep_q6k): C++ vs the numpy reference
+# fused-layout packers (prep_q4k/q5k/q6k/q8_0): C++ vs the numpy reference
 # ---------------------------------------------------------------------------
 
-def _numpy_prep(prep_fn, monkeypatch, module, native_name, raw, n, k):
-    """Run the in-module numpy packer with the native path disabled."""
-    monkeypatch.setattr(module, native_name, lambda *a, **kw: None)
-    return prep_fn(raw, n, k)
+def _packer_case(kind):
+    """(pallas module, numpy-ref fn name, native fn name, quant codec,
+    GGMLType) for each fused format."""
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas import (
+        q5matmul, q6matmul, q8matmul, qmatmul,
+    )
+
+    return {
+        "q4k": (qmatmul, "prep_q4k", "native_prep_q4k",
+                quants.quant_q4_k, GGMLType.Q4_K),
+        "q5k": (q5matmul, "prep_q5k", "native_prep_q5k",
+                quants.quant_q5_k, GGMLType.Q5_K),
+        "q6k": (q6matmul, "prep_q6k", "native_prep_q6k",
+                quants.quant_q6_k, GGMLType.Q6_K),
+        "q8_0": (q8matmul, "prep_q8_0", "native_prep_q8_0",
+                 quants.quant_q8_0, GGMLType.Q8_0),
+    }[kind]
 
 
-@pytest.mark.parametrize("n,k", [(128, 2048), (256, 4096), (8, 2048)])
-def test_prep_q4k_bit_exact(monkeypatch, n, k):
-    from llama_fastapi_k8s_gpu_tpu.native import native_prep_q4k
-    from llama_fastapi_k8s_gpu_tpu.ops.pallas import qmatmul
-
-    rng = np.random.default_rng(n + k)
-    raw = quants.quant_q4_k(
-        (rng.standard_normal(n * k) * 0.05).astype(np.float32))
-    nat = native_prep_q4k(raw, n, k)
-    assert nat is not None
+@pytest.mark.parametrize("raw_kind", ["codec", "random_bytes"])
+@pytest.mark.parametrize("kind", ["q4k", "q5k", "q6k", "q8_0"])
+@pytest.mark.parametrize("n,k", [(128, 2048), (8, 4096)])
+def test_prep_bit_exact(monkeypatch, kind, raw_kind, n, k):
+    """The threaded C++ packers must reproduce the numpy reference chains
+    bit-for-bit: int planes exactly, bf16 scale planes including the
+    NaN/inf f16 scale patterns random raw bytes produce (pins bf16_rne's
+    sign-preserving quiet-NaN canonicalization against XLA's cast)."""
     import llama_fastapi_k8s_gpu_tpu.native as native_mod
-    monkeypatch.setattr(native_mod, "native_prep_q4k", lambda *a, **kw: None)
-    ref = qmatmul.prep_q4k(raw, n, k)
-    assert np.array_equal(nat["qs"], np.asarray(ref["qs"]))
-    assert np.array_equal(nat["sm"].view(np.uint16),
-                          np.asarray(ref["sm"]).view(np.uint16))
 
-
-@pytest.mark.parametrize("n,k", [(128, 2048), (256, 4096), (8, 2048)])
-def test_prep_q6k_bit_exact(monkeypatch, n, k):
-    from llama_fastapi_k8s_gpu_tpu.native import native_prep_q6k
-    from llama_fastapi_k8s_gpu_tpu.ops.pallas import q6matmul
-
-    rng = np.random.default_rng(n + k + 1)
-    raw = quants.quant_q6_k(
-        (rng.standard_normal(n * k) * 0.05).astype(np.float32))
-    nat = native_prep_q6k(raw, n, k)
+    module, ref_name, nat_name, codec, gtype = _packer_case(kind)
+    rng = np.random.default_rng(hash((kind, raw_kind, n, k)) % 2**32)
+    if raw_kind == "codec":
+        raw = codec((rng.standard_normal(n * k) * 0.05).astype(np.float32))
+    else:
+        _, block_bytes = GGML_BLOCK_SIZES[gtype]
+        block_elems = GGML_BLOCK_SIZES[gtype][0]
+        raw = rng.integers(0, 256, size=(n * k // block_elems) * block_bytes,
+                           dtype=np.uint8)
+    nat = getattr(native_mod, nat_name)(raw, n, k)
     assert nat is not None
-    import llama_fastapi_k8s_gpu_tpu.native as native_mod
-    monkeypatch.setattr(native_mod, "native_prep_q6k", lambda *a, **kw: None)
-    ref = q6matmul.prep_q6k(raw, n, k)
-    for key in ("q4", "q2"):
-        assert np.array_equal(nat[key], np.asarray(ref[key])), key
-    assert np.array_equal(nat["sm6"].view(np.uint16),
-                          np.asarray(ref["sm6"]).view(np.uint16))
-
-
-def test_prep_q4k_random_bytes_bit_exact(monkeypatch):
-    """Arbitrary raw bytes (any f16 scale pattern) — not just codec output."""
-    from llama_fastapi_k8s_gpu_tpu.native import native_prep_q4k
-    from llama_fastapi_k8s_gpu_tpu.ops.pallas import qmatmul
-
-    n, k = 16, 2048
-    rng = np.random.default_rng(7)
-    raw = _random_blocks(rng, GGMLType.Q4_K, n * k // 256)
-    nat = native_prep_q4k(raw, n, k)
-    assert nat is not None
-    import llama_fastapi_k8s_gpu_tpu.native as native_mod
-    monkeypatch.setattr(native_mod, "native_prep_q4k", lambda *a, **kw: None)
-    ref = qmatmul.prep_q4k(raw, n, k)
-    assert np.array_equal(nat["qs"], np.asarray(ref["qs"]))
-    assert np.array_equal(nat["sm"].view(np.uint16),
-                          np.asarray(ref["sm"]).view(np.uint16))
+    monkeypatch.setattr(native_mod, nat_name, lambda *a, **kw: None)
+    ref = getattr(module, ref_name)(raw, n, k)
+    assert sorted(nat) == sorted(ref)
+    for key in nat:
+        a, b = nat[key], np.asarray(ref[key])
+        if a.dtype == np.int8:
+            assert np.array_equal(a, b), (kind, key)
+        else:
+            assert np.array_equal(a.view(np.uint16), b.view(np.uint16)), \
+                (kind, key)
