@@ -49,6 +49,33 @@ A10G_Q4KM_8B_TOK_S = 45.0  # midpoint of the 30-60 tok/s llama.cpp A10G range
 
 _INIT_MARK = "LFKT_INIT_OK"
 
+#: leaf key that marks a fused-layout weight dict per bench format — the
+#: label-honesty check (report the fused format only if any tensor actually
+#: got the layout).  Shared with bench_server.py.
+FUSED_KEYS = {"q4k": "qs", "q8": "q8", "q4km": "qs"}
+
+
+def probe_fused_or_degrade(wfmt: str, tag: str):
+    """Compile-probe the fused kernels ``wfmt`` relies on; on a Mosaic
+    failure return ("int8", reason) so the caller serves/benches the
+    fallback with correct attribution.  Shared by bench.py/bench_server.py
+    so the two benches can't diverge in what they degrade."""
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import (
+        probe_fused_q4k,
+        probe_fused_q6k,
+        probe_fused_q8,
+    )
+
+    probes = {"q4k": [probe_fused_q4k], "q8": [probe_fused_q8],
+              "q4km": [probe_fused_q4k, probe_fused_q6k]}
+    for pr in probes.get(wfmt, []):
+        err = pr()
+        if err is not None:
+            reason = f"fused {wfmt.upper()} kernel ({pr.__name__}): {err}"[:300]
+            print(f"{tag}: {reason}; using int8", file=sys.stderr, flush=True)
+            return "int8", reason
+    return wfmt, None
+
 
 # ---------------------------------------------------------------------------
 # child: the actual benchmark (runs with LFKT_BENCH_CHILD=1)
@@ -403,23 +430,13 @@ def child_main() -> None:
     # the result JSON — instead of zeroing the whole headline
     from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import (
         probe_flash_attention,
-        probe_fused_q4k,
-        probe_fused_q6k,
-        probe_fused_q8,
     )
 
     fallbacks = {}
-    probes = {"q4k": [probe_fused_q4k], "q8": [probe_fused_q8],
-              "q4km": [probe_fused_q4k, probe_fused_q6k]}
-    for pr in probes.get(wfmt, []):
-        err = pr()
-        if err is not None:
-            fallbacks["fmt_fallback"] = (
-                f"fused {wfmt.upper()} kernel ({pr.__name__}): {err}"[:300])
-            print(f"bench: {fallbacks['fmt_fallback']}; using int8",
-                  file=sys.stderr, flush=True)
-            wfmt = fmt_label = "int8"
-            break
+    wfmt, reason = probe_fused_or_degrade(wfmt, "bench")
+    if reason is not None:
+        fallbacks["fmt_fallback"] = reason
+        fmt_label = "int8"
     if cfg.attn_impl == "pallas":
         err = probe_flash_attention()
         if err is not None:
@@ -432,7 +449,7 @@ def child_main() -> None:
     params = synth_params_device(cfg, fmt=wfmt)
     # label honesty: report the fused format only if any tensor actually
     # got the layout (tiny shapes fall back to int8)
-    fused_key = {"q4k": "qs", "q8": "q8", "q4km": "qs"}.get(wfmt)
+    fused_key = FUSED_KEYS.get(wfmt)
     if fused_key is not None and not any(
             isinstance(v, dict) and fused_key in v
             for v in [*params["layers"].values(), params["output"]]):

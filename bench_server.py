@@ -54,10 +54,13 @@ def main() -> None:
     from llama_fastapi_k8s_gpu_tpu.tokenizer import BPETokenizer
 
     preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
-    wfmt = os.environ.get("LFKT_BENCH_FMT", "q4k")
+    wfmt = os.environ.get("LFKT_BENCH_FMT", "q4km")
     n_req = int(os.environ.get("LFKT_BENCH_N_REQ", "12"))
     max_tokens = int(os.environ.get("LFKT_BENCH_MAX_TOKENS", "48"))
     port = int(os.environ.get("LFKT_BENCH_PORT", "8017"))
+    spec_decode = os.environ.get("LFKT_SPEC_DECODE", "off")
+    spec_draft = int(os.environ.get("LFKT_SPEC_DRAFT", "8"))
+    fullctx = os.environ.get("LFKT_BENCH_FULLCTX") == "1"
 
     if preset == "tiny":
         cfg = ModelConfig(vocab_size=0, dim=128, n_layers=2, n_heads=8,
@@ -70,24 +73,16 @@ def main() -> None:
         n_merges = 280_000
 
     dev = jax.devices()[0]
-    if wfmt in ("q4k", "q8"):
-        from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import (
-            probe_fused_q4k,
-            probe_fused_q8,
-        )
+    from bench import FUSED_KEYS, probe_fused_or_degrade
 
-        err = (probe_fused_q4k if wfmt == "q4k" else probe_fused_q8)()
-        if err is not None:
-            print(f"bench_server: fused {wfmt.upper()} probe failed "
-                  f"({err}); int8", file=sys.stderr, flush=True)
-            wfmt = "int8"
+    wfmt, _ = probe_fused_or_degrade(wfmt, "bench_server")
     tokens, merges, types = synth_bpe_vocab(n_merges=n_merges)
     cfg = dataclasses.replace(cfg, vocab_size=len(tokens))
     tok = BPETokenizer(tokens, merges, types,
                        bos_id=tokens.index("<|begin_of_text|>"),
                        eos_id=tokens.index("<|eot_id|>"))
     params = synth_params_device(cfg, fmt=wfmt)
-    fused_key = {"q4k": "qs", "q8": "q8"}.get(wfmt)
+    fused_key = FUSED_KEYS.get(wfmt)
     if fused_key is not None and not any(
             isinstance(v, dict) and fused_key in v
             for v in [*params["layers"].values(), params["output"]]):
@@ -109,11 +104,14 @@ def main() -> None:
         eng = ContinuousEngine.from_parts(
             params, cfg, tok, template_kind="llama3",
             max_gen_tokens=max_tokens, attn_impl=cfg.attn_impl,
-            dp=1, batch_size=batch)
+            dp=1, batch_size=batch,
+            spec_decode=spec_decode, spec_draft=spec_draft)
     else:
         eng = Engine.from_parts(params, cfg, tok, template_kind="llama3",
                                 max_gen_tokens=max_tokens,
-                                attn_impl=cfg.attn_impl)
+                                attn_impl=cfg.attn_impl,
+                                spec_decode=spec_decode,
+                                spec_draft=spec_draft)
     # compile every shape BEFORE the server phase, exactly like the
     # production factory (server/app.py calls eng.warmup() at startup);
     # without it the first request compiles for ~60 s and the 25 s
@@ -137,6 +135,30 @@ def main() -> None:
                 raise
             time.sleep(0.5)
 
+    # LFKT_BENCH_FULLCTX=1: a chat history that fills the reference's whole
+    # context budget (api.py:17 MAX_CONTEXT_TOKENS=1024 at the chars/4
+    # estimate, each message at the 400-char clip), so prefill runs the
+    # full 1024-token bucket through the server stack — the TTFT shape the
+    # short-prompt run doesn't exercise (VERDICT r3 #6).
+    if fullctx:
+        lines = ("The quick brown fox jumps over the lazy dog near the "
+                 "riverbank while autumn leaves drift slowly down. ")
+        # size the history with the REAL tokenizer (the reference's chars/4
+        # estimate over-admits for low-merge synthetic vocabs): take a
+        # token-budgeted slice of a long text, then split it into
+        # clip-sized (400-char) turns
+        budget = max(32, cfg.n_ctx - 200)   # headroom: template + system
+        ids = tok.encode(lines * 40)
+        text = tok.decode(ids[:budget])
+        context = [
+            {"turn": "user" if i % 2 == 0 else "bot",
+             "message": text[j:j + 400]}
+            for i, j in enumerate(range(0, len(text), 400))
+        ] + [{"turn": "user", "message": "Tell me about the weather today."}]
+    else:
+        context = [
+            {"turn": "user", "message": "Tell me about the weather today."},
+        ]
     payload = json.dumps({  # the reference's wire shape (data/requests.py)
         "bot_profile": {
             "name": "Ada",
@@ -144,9 +166,7 @@ def main() -> None:
             "system_prompt": "You are a concise assistant.",
         },
         "user_profile": {"name": "Sam"},
-        "context": [
-            {"turn": "user", "message": "Tell me about the weather today."},
-        ],
+        "context": context,
     }).encode()
 
     def post(path):
@@ -198,20 +218,28 @@ def main() -> None:
     oks, rejects, errors = [], [], []
     lock = threading.Lock()
 
+    def read_metrics_counters(names) -> dict | None:
+        """Scrape named counters off the app's /metrics; None when the
+        endpoint is unreadable (so callers report null, not fabricated
+        zeros)."""
+        try:
+            with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+                text = r.read().decode()
+        except Exception:  # noqa: BLE001 — measurement aid, not the result
+            return None
+        out = {n: 0.0 for n in names}
+        for ln in text.splitlines():
+            parts = ln.split()
+            if len(parts) == 2 and parts[0] in out:
+                out[parts[0]] = float(parts[1])
+        return out
+
     def read_generated_total() -> float | None:
         # server-side counter of usage.completion_tokens per completed
         # request (`/response` strips the usage dict off the wire, so the
-        # client can't count; app.py:237-238 records it before stripping).
-        # None (not 0.0) when unreadable, so agg_tok_s reports null rather
-        # than a fabricated zero.
-        try:
-            with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
-                for ln in r.read().decode().splitlines():
-                    if ln.startswith("generated_tokens_total "):
-                        return float(ln.split()[1])
-        except Exception:  # noqa: BLE001 — measurement aid, not the result
-            pass
-        return None
+        # client can't count; app.py:237-238 records it before stripping)
+        got = read_metrics_counters(("generated_tokens_total",))
+        return None if got is None else got["generated_tokens_total"]
 
     def worker(seed: int):
         # closed loop: each thread completes `per` requests, retrying 503s
@@ -265,6 +293,8 @@ def main() -> None:
     p = lambda v, q: v[min(len(v) - 1, int(q * len(v)))]  # noqa: E731
     result = {
         "metric": (f"server_ttft_ms_p50[/response,{preset},{wfmt}"
+                   + (",fullctx" if fullctx else "")
+                   + (",spec" if spec_decode == "lookup" else "")
                    + (f",batch{batch}]" if batch > 1 else "]")),
         "value": round(p(ttft, 0.5), 1),
         "unit": "ms",
@@ -292,6 +322,15 @@ def main() -> None:
         "batch_size": batch,
         "device": str(dev),
     }
+    if spec_decode == "lookup":
+        # acceptance telemetry: accepted/drafted is THE pays-or-not number
+        if batch > 1:
+            result["spec"] = eng.scheduler_stats().get("spec")
+        else:
+            # serial engine: scrape the spec counters the app exports
+            result["spec"] = read_metrics_counters(
+                ("spec_verify_steps_total", "spec_drafted_tokens_total",
+                 "spec_accepted_tokens_total", "spec_fallback_steps_total"))
     print(json.dumps(result), flush=True)
     os._exit(0)  # daemon server thread: skip graceful asyncio teardown
 
