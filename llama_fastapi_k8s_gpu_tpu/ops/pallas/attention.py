@@ -149,8 +149,8 @@ def _pick_block(n: int, preferred: int) -> int:
 )
 def flash_attention(
     q: jax.Array,          # (S, n_heads, hd)
-    k: jax.Array,          # (n_ctx, n_kv_heads, hd) — full ring cache
-    v: jax.Array,          # (n_ctx, n_kv_heads, hd)
+    k: jax.Array,          # (n_kv_heads, n_ctx, hd) — full ring cache,
+    v: jax.Array,          #   HEAD-MAJOR (models/llama.py init_cache)
     pos_offset: jax.Array, # scalar int32: cache position of q[0]
     sm_scale: float,
     sliding_window: int = 0,
@@ -162,10 +162,11 @@ def flash_attention(
 
     Returns (S, n_heads, hd) in q.dtype.  The causal mask ``key_pos <=
     q_pos`` makes unwritten cache slots invisible, exactly like the XLA
-    path in ``models/llama.py``.
+    path in ``models/llama.py``.  K/V arrive head-major, which is the
+    kernel's own block layout — no ring-sized transpose on the way in.
     """
     S, n_heads, hd = q.shape
-    n_ctx, n_kv, _ = k.shape
+    n_kv, n_ctx, _ = k.shape
     group = n_heads // n_kv
     gs = group * S
 
@@ -174,8 +175,8 @@ def flash_attention(
 
     # (S, n_kv, group, hd) → (n_kv, group*S, hd): row = g*S + s
     qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3).reshape(n_kv, gs, hd)
-    kk = k.transpose(1, 0, 2)                      # (n_kv, n_ctx, hd)
-    vv = v.transpose(1, 0, 2)
+    kk = k                                         # (n_kv, n_ctx, hd)
+    vv = v
 
     grid = (n_kv, gs // bq, n_ctx // bk)
     kernel = functools.partial(

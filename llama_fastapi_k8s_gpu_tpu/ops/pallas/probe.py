@@ -145,8 +145,8 @@ def probe_flash_attention() -> str | None:
         itp = use_interpret()
         S, H, KV, HD, CTX = (8, 2, 2, 128, 32) if itp else (128, 32, 8, 128, 256)
         q = jnp.ones((S, H, HD), jnp.bfloat16)
-        k = jnp.ones((CTX, KV, HD), jnp.bfloat16)
-        v = jnp.ones((CTX, KV, HD), jnp.bfloat16)
+        k = jnp.ones((KV, CTX, HD), jnp.bfloat16)   # head-major ring layout
+        v = jnp.ones((KV, CTX, HD), jnp.bfloat16)
         y = flash_attention(q, k, v, jnp.int32(0), sm_scale=HD ** -0.5,
                             interpret=itp)
         float(y.astype(jnp.float32).sum())

@@ -122,11 +122,12 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
 
 
 def cache_shardings(cfg: ModelConfig, mesh: Mesh, batched: bool = False):
-    """KV cache (L, ctx, n_kv, hd): kv-heads over tp; batch (if any) over dp."""
+    """Head-major KV cache (L, n_kv, ctx, hd): kv-heads over tp; batch (if
+    any) over dp."""
     if batched:
-        s = _ns(mesh, "dp", None, None, "tp", None)
+        s = _ns(mesh, "dp", None, "tp", None, None)
     else:
-        s = _ns(mesh, None, None, "tp", None)
+        s = _ns(mesh, None, "tp", None, None)
     return {"k": s, "v": s}
 
 

@@ -79,10 +79,11 @@ def _masked_chunk_scores(qg, k_chunk, v_chunk, q_pos, key_offset,
 
     Returns ``(scores, vv)`` with scores (n_kv, group, S, C_loc) f32 and
     vv (n_kv, C_loc, hd) ready for the ``ngsc,nch->ngsh`` PV einsum.
+    ``k_chunk``/``v_chunk`` are head-major (n_kv, C_loc, hd).
     """
-    C_loc = k_chunk.shape[0]
-    kk = k_chunk.transpose(1, 0, 2)                    # (n_kv, C_loc, hd)
-    vv = v_chunk.transpose(1, 0, 2)
+    C_loc = k_chunk.shape[1]
+    kk = k_chunk
+    vv = v_chunk
     scores = jnp.einsum(
         "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
     ) * sm_scale
@@ -99,7 +100,7 @@ def _masked_chunk_scores(qg, k_chunk, v_chunk, q_pos, key_offset,
 
 def ring_attention(
     q: jax.Array,           # (S, n_heads, hd), seq-sharded over sp
-    k: jax.Array,           # (n_ctx, n_kv, hd), seq-sharded over sp
+    k: jax.Array,           # (n_kv, n_ctx, hd) head-major, seq-sharded over sp
     v: jax.Array,
     pos_offset: jax.Array,  # scalar int32: cache position of global q[0]
     sm_scale: float,
@@ -112,10 +113,10 @@ def ring_attention(
     n_ring = mesh.shape[ax]
 
     def local_fn(q, k, v, pos_offset):
-        # local shapes: q (S_loc, H_loc, hd), k/v (C_loc, n_kv_loc, hd)
+        # local shapes: q (S_loc, H_loc, hd), k/v (n_kv_loc, C_loc, hd)
         s_idx = jax.lax.axis_index(ax)
         S_loc, H, hd = q.shape
-        C_loc, n_kv, _ = k.shape
+        n_kv, C_loc, _ = k.shape
         group = H // n_kv
         qg = _group_queries(q, n_kv)
         q_pos = (pos_offset + s_idx * S_loc + jnp.arange(S_loc))[:, None]
@@ -154,7 +155,7 @@ def ring_attention(
     return shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(ax, "tp", None), P(ax, "tp", None), P(ax, "tp", None), P()),
+        in_specs=(P(ax, "tp", None), P("tp", ax, None), P("tp", ax, None), P()),
         out_specs=P(ax, "tp", None),
         check_vma=False,
     )(q, k, v, jnp.asarray(pos_offset, jnp.int32))
@@ -166,7 +167,7 @@ def ring_attention(
 
 def sharded_decode_attention(
     q: jax.Array,           # (S, n_heads, hd) — S tiny (1), replicated over sp
-    k: jax.Array,           # (n_ctx, n_kv, hd), seq-sharded over sp
+    k: jax.Array,           # (n_kv, n_ctx, hd) head-major, seq-sharded over sp
     v: jax.Array,
     pos_offset: jax.Array,  # scalar: cache position of q[0]
     sm_scale: float,
@@ -180,7 +181,7 @@ def sharded_decode_attention(
     def local_fn(q, k, v, pos_offset):
         s_idx = jax.lax.axis_index(ax)
         S, H, hd = q.shape
-        C_loc, n_kv, _ = k.shape
+        n_kv, C_loc, _ = k.shape
         qg = _group_queries(q, n_kv)
         q_pos = (pos_offset + jnp.arange(S))[:, None]
         scores, vv = _masked_chunk_scores(
@@ -203,7 +204,7 @@ def sharded_decode_attention(
     return shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(None, "tp", None), P(ax, "tp", None), P(ax, "tp", None), P()),
+        in_specs=(P(None, "tp", None), P("tp", ax, None), P("tp", ax, None), P()),
         out_specs=P(None, "tp", None),
         check_vma=False,
     )(q, k, v, jnp.asarray(pos_offset, jnp.int32))
@@ -214,8 +215,9 @@ def sharded_decode_attention(
 # ---------------------------------------------------------------------------
 
 def sp_state_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
-    """Cache sharded over n_ctx on sp (heads over tp)."""
-    s = NamedSharding(mesh, P(None, "sp", "tp", None))
+    """Head-major cache (L, n_kv, n_ctx, hd): n_ctx sharded over sp,
+    kv-heads over tp."""
+    s = NamedSharding(mesh, P(None, "tp", "sp", None))
     return {"k": s, "v": s}
 
 

@@ -71,13 +71,14 @@ def test_device_dequant_bf16_output():
 # ---------------------------------------------------------------------------
 
 def _ref_attention(q, k, v, pos_offset, sm_scale, sliding_window=0):
-    """The XLA path from models/llama.py, as a standalone oracle."""
+    """The XLA path from models/llama.py, as a standalone oracle.
+    k/v head-major (n_kv, n_ctx, hd), matching init_cache."""
     S, H, hd = q.shape
-    n_ctx, n_kv, _ = k.shape
+    n_kv, n_ctx, _ = k.shape
     group = H // n_kv
     qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
-    kk = k.transpose(1, 0, 2)
-    vv = v.transpose(1, 0, 2)
+    kk = k
+    vv = v
     scores = jnp.einsum(
         "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
     ) * sm_scale
@@ -106,8 +107,8 @@ def _ref_attention(q, k, v, pos_offset, sm_scale, sliding_window=0):
 def test_flash_attention_matches_xla(S, n_ctx, H, n_kv, hd, offset, window):
     keys = jax.random.split(jax.random.PRNGKey(S + n_ctx + H), 3)
     q = jax.random.normal(keys[0], (S, H, hd), jnp.float32)
-    k = jax.random.normal(keys[1], (n_ctx, n_kv, hd), jnp.float32)
-    v = jax.random.normal(keys[2], (n_ctx, n_kv, hd), jnp.float32)
+    k = jax.random.normal(keys[1], (n_kv, n_ctx, hd), jnp.float32)
+    v = jax.random.normal(keys[2], (n_kv, n_ctx, hd), jnp.float32)
     # k/v carry garbage in unwritten ring slots on purpose: the causal mask
     # must hide them, which is exactly what a real cache relies on
     sm = hd ** -0.5
@@ -142,8 +143,8 @@ def test_flash_attention_block_branches(S, n_ctx, H, n_kv, hd, offset,
                                         window, bq, bk):
     keys = jax.random.split(jax.random.PRNGKey(7 * S + offset + bq), 3)
     q = jax.random.normal(keys[0], (S, H, hd), jnp.float32)
-    k = jax.random.normal(keys[1], (n_ctx, n_kv, hd), jnp.float32)
-    v = jax.random.normal(keys[2], (n_ctx, n_kv, hd), jnp.float32)
+    k = jax.random.normal(keys[1], (n_kv, n_ctx, hd), jnp.float32)
+    v = jax.random.normal(keys[2], (n_kv, n_ctx, hd), jnp.float32)
     sm = hd ** -0.5
     got = flash_attention(
         q, k, v, jnp.int32(offset), sm_scale=sm, sliding_window=window,

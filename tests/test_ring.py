@@ -39,12 +39,13 @@ def params():
 
 
 def _ref_attention(q, k, v, pos_offset, sm_scale, sliding_window=0):
+    """k/v head-major (n_kv, n_ctx, hd), matching init_cache."""
     S, H, hd = q.shape
-    n_ctx, n_kv, _ = k.shape
+    n_kv, n_ctx, _ = k.shape
     group = H // n_kv
     qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
     scores = jnp.einsum(
-        "ngsh,nch->ngsc", qg, k.transpose(1, 0, 2),
+        "ngsh,nch->ngsc", qg, k,
         preferred_element_type=jnp.float32,
     ) * sm_scale
     key_pos = jnp.arange(n_ctx)
@@ -54,7 +55,7 @@ def _ref_attention(q, k, v, pos_offset, sm_scale, sliding_window=0):
         mask &= key_pos[None, :] > q_pos[:, None] - sliding_window
     scores = jnp.where(mask[None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    ctx = jnp.einsum("ngsc,nch->ngsh", probs, v.transpose(1, 0, 2))
+    ctx = jnp.einsum("ngsc,nch->ngsh", probs, v)
     return ctx.transpose(2, 0, 1, 3).reshape(S, H, hd)
 
 
@@ -63,8 +64,8 @@ def test_ring_attention_matches_reference(mesh, offset, window):
     S, n_ctx, H, n_kv, hd = 32, 64, 4, 2, 32
     keys = jax.random.split(jax.random.PRNGKey(7), 3)
     q = jax.random.normal(keys[0], (S, H, hd), jnp.float32)
-    k = jax.random.normal(keys[1], (n_ctx, n_kv, hd), jnp.float32)
-    v = jax.random.normal(keys[2], (n_ctx, n_kv, hd), jnp.float32)
+    k = jax.random.normal(keys[1], (n_kv, n_ctx, hd), jnp.float32)
+    v = jax.random.normal(keys[2], (n_kv, n_ctx, hd), jnp.float32)
     with ring_context(mesh):
         got = ring_attention(q, k, v, jnp.int32(offset), sm_scale=hd ** -0.5,
                              sliding_window=window)
@@ -77,8 +78,8 @@ def test_sharded_decode_attention_matches_reference(mesh):
     n_ctx, H, n_kv, hd = 64, 4, 2, 32
     keys = jax.random.split(jax.random.PRNGKey(11), 3)
     q = jax.random.normal(keys[0], (1, H, hd), jnp.float32)
-    k = jax.random.normal(keys[1], (n_ctx, n_kv, hd), jnp.float32)
-    v = jax.random.normal(keys[2], (n_ctx, n_kv, hd), jnp.float32)
+    k = jax.random.normal(keys[1], (n_kv, n_ctx, hd), jnp.float32)
+    v = jax.random.normal(keys[2], (n_kv, n_ctx, hd), jnp.float32)
     with ring_context(mesh):
         got = sharded_decode_attention(q, k, v, jnp.int32(37),
                                        sm_scale=hd ** -0.5)
