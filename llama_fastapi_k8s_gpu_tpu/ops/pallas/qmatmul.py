@@ -194,13 +194,26 @@ def dequant_ref(w: dict) -> jax.Array:
 # kernel
 # ---------------------------------------------------------------------------
 
+def _kernel_variant() -> str:
+    """LFKT_Q4K_KERNEL: ``cur`` (default) | ``resplit``.  Both compute
+    bit-identical planes; they differ only in the VPU dependency graph of
+    the low-nibble reconstruction (see kernel body).  Read at trace time —
+    a process-level knob for kernel A/B on hardware, not a runtime switch."""
+    import os
+
+    v = os.environ.get("LFKT_Q4K_KERNEL", "cur").strip().lower()
+    if v not in ("cur", "resplit"):
+        # an A/B run with a typo'd value must fail loud, not compare
+        # the default against itself
+        raise ValueError(f"LFKT_Q4K_KERNEL must be cur|resplit, got {v!r}")
+    return v
+
+
 def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret):
     # xpa (B, TKA) bf16 permuted+augmented; qs (TN, TK/2) int8;
     # sm (1, TN, 128) bf16
     TN = qs_ref.shape[0]
     v = qs_ref[...].astype(jnp.float32)
-    h = jnp.floor(v * 0.0625)                         # hi − 8
-    l = v - h * 16.0                                  # lo
     sm = sm_ref[...].reshape(TN, 128)
     sc, mn = sm[:, :_SUBS], sm[:, _SUBS:]
     sc2 = jnp.concatenate([sc, sc], axis=1)           # (TN, 128)
@@ -210,8 +223,20 @@ def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret):
         from jax.experimental.pallas import tpu as pltpu
 
         sc_exp = pltpu.repeat(sc2, TK // 256, axis=1).astype(jnp.float32)
-    a_lo = (l * sc_exp).astype(jnp.bfloat16)          # (TN, TK/2)
-    a_hi = (h * sc_exp).astype(jnp.bfloat16)
+    h = jnp.floor(v * 0.0625)                         # hi − 8
+    if _kernel_variant() == "resplit":
+        # lsc = v·sc − 16·(h·sc): all three f32 quantities are exact
+        # (v, h ≤ 8-bit ints × bf16 scale fits f32), so the cancellation
+        # reproduces l·sc EXACTLY — bit-identical planes to the `cur`
+        # branch with a different VPU dependency graph (the l = v − 16h
+        # reconstruction never materializes)
+        a_hi_f = h * sc_exp
+        a_lo = (v * sc_exp - 16.0 * a_hi_f).astype(jnp.bfloat16)
+        a_hi = a_hi_f.astype(jnp.bfloat16)
+    else:
+        l = v - h * 16.0                              # lo
+        a_lo = (l * sc_exp).astype(jnp.bfloat16)      # (TN, TK/2)
+        a_hi = (h * sc_exp).astype(jnp.bfloat16)
     corr = jnp.concatenate([-mn, sc * 8.0], axis=1).astype(jnp.bfloat16)
 
     xpa = xpa_ref[...]

@@ -153,3 +153,32 @@ def test_q4k_params_shard_over_mesh():
     mesh = make_mesh(dp=2, tp=2, sp=2)
     sharded = shard_params(params, mesh)
     assert sharded["layers"]["wq"]["qs"].shape == params["layers"]["wq"]["qs"].shape
+
+
+def test_resplit_variant_bit_identical(monkeypatch):
+    """LFKT_Q4K_KERNEL=resplit must produce BIT-identical output to the
+    default: its lsc = v*sc - 16*(h*sc) cancellation is exact in f32."""
+    import numpy as np
+
+    from llama_fastapi_k8s_gpu_tpu.gguf.quants import quant_q4_k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import prep_q4k, q4k_matmul
+
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas import qmatmul as qm
+
+    rng = np.random.default_rng(0)
+    n, k = 64, 2048
+    w = (rng.standard_normal((n, k)) * 0.05).astype(np.float32)
+    wd = prep_q4k(quant_q4_k(w.reshape(-1)), n, k)
+    x = jnp.asarray(rng.standard_normal((4, k)), jnp.bfloat16)
+    # the partitioned builder is lru_cached + jitted: clear it around each
+    # call so the env knob actually re-traces the kernel body
+    try:
+        monkeypatch.delenv("LFKT_Q4K_KERNEL", raising=False)
+        qm._q4k_2d_partitioned.cache_clear()
+        a = np.asarray(q4k_matmul(x, wd, interpret=True))
+        monkeypatch.setenv("LFKT_Q4K_KERNEL", "resplit")
+        qm._q4k_2d_partitioned.cache_clear()
+        b = np.asarray(q4k_matmul(x, wd, interpret=True))
+    finally:
+        qm._q4k_2d_partitioned.cache_clear()  # drop the resplit program
+    assert np.array_equal(a, b)
