@@ -51,6 +51,7 @@ from jax.experimental import pallas as pl
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
 from .qmatmul import (
     TK,
+    _env_variant,
     _interpret,
     _pick_tn,
     _spec_axis,
@@ -184,7 +185,8 @@ def dequant_ref6(w: dict) -> jax.Array:
 # kernel
 # ---------------------------------------------------------------------------
 
-def _q6k_matmul_kernel(xpa_ref, q4_ref, q2_ref, sm_ref, o_ref, *, interpret):
+def _q6k_matmul_kernel(xpa_ref, q4_ref, q2_ref, sm_ref, o_ref, *, interpret,
+                       variant="cur"):
     TN = q4_ref.shape[0]
     v4 = q4_ref[...].astype(jnp.float32)              # (TN, TK/2)
     h = jnp.floor(v4 * 0.0625)
@@ -192,12 +194,23 @@ def _q6k_matmul_kernel(xpa_ref, q4_ref, q2_ref, sm_ref, o_ref, *, interpret):
     nib = jnp.concatenate([l, h], axis=1)             # (TN, TK); hi bias → corr
 
     u = q2_ref[...].astype(jnp.float32) + 128.0       # (TN, TK/4)
-    c3 = jnp.floor(u * (1.0 / 64.0))
-    r = u - 64.0 * c3
-    c2 = jnp.floor(r * 0.0625)
-    r = r - 16.0 * c2
-    c1 = jnp.floor(r * 0.25)
-    c0 = r - 4.0 * c1
+    if variant == "parfloor":
+        # all floors depend only on u (u ≤ 255 integer; /4,/16,/64 are
+        # exact power-of-two scalings, so every quantity is an exact f32
+        # integer and the crumbs come out bit-identical to the chain)
+        c3 = jnp.floor(u * (1.0 / 64.0))
+        f2 = jnp.floor(u * 0.0625)
+        f1 = jnp.floor(u * 0.25)
+        c2 = f2 - 4.0 * c3
+        c1 = f1 - 4.0 * f2
+        c0 = u - 4.0 * f1
+    else:
+        c3 = jnp.floor(u * (1.0 / 64.0))
+        r = u - 64.0 * c3
+        c2 = jnp.floor(r * 0.0625)
+        r = r - 16.0 * c2
+        c1 = jnp.floor(r * 0.25)
+        c0 = r - 4.0 * c1
     crumb = jnp.concatenate([c0, c1, c2, c3], axis=1)  # (TN, TK)
 
     sm = sm_ref[...].reshape(TN, 128)                 # eff = d·sc
@@ -246,21 +259,22 @@ def _q6k_specs(B: int, TN: int):
 
 
 def _q6k_2d_raw(xpa: jax.Array, q4: jax.Array, q2: jax.Array, sm: jax.Array,
-                interpret: bool) -> jax.Array:
+                interpret: bool, variant: str = "cur") -> jax.Array:
     B, KA = xpa.shape
     K = (KA // TKA6) * TK
     N = q4.shape[0]
     TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q6K))
     in_specs, out_spec = _q6k_specs(B, TN)
     return plain_pallas_call(
-        functools.partial(_q6k_matmul_kernel, interpret=interpret),
+        functools.partial(_q6k_matmul_kernel, interpret=interpret,
+                          variant=variant),
         (N // TN, K // TK), in_specs, out_spec,
         jax.ShapeDtypeStruct((B, N), jnp.float32), interpret,
     )(xpa, q4, q2, sm)
 
 
-@functools.lru_cache(maxsize=4)
-def _q6k_2d_partitioned(interpret: bool):
+@functools.lru_cache(maxsize=8)
+def _q6k_2d_partitioned(interpret: bool, variant: str = "cur"):
     """GSPMD rule mirroring the Q4_K kernel's: partition over N (and rows),
     never over K; tp-sharded weights compute locally."""
     from jax.experimental.custom_partitioning import custom_partitioning
@@ -268,7 +282,7 @@ def _q6k_2d_partitioned(interpret: bool):
 
     @custom_partitioning
     def fn(xpa, q4, q2, sm):
-        return _q6k_2d_raw(xpa, q4, q2, sm, interpret)
+        return _q6k_2d_raw(xpa, q4, q2, sm, interpret, variant)
 
     def partition(mesh, arg_shapes, result_shape):
         xp_s, q4_s, q2_s, sm_s = (a.sharding for a in arg_shapes)
@@ -283,7 +297,7 @@ def _q6k_2d_partitioned(interpret: bool):
         result_sharding = NamedSharding(mesh, P(rows, n_ax))
 
         def lower(xpa, q4, q2, sm):
-            return _q6k_2d_raw(xpa, q4, q2, sm, interpret)
+            return _q6k_2d_raw(xpa, q4, q2, sm, interpret, variant)
 
         return mesh, lower, result_sharding, arg_shardings
 
@@ -302,14 +316,15 @@ def _q6k_2d_partitioned(interpret: bool):
 
 def _q6k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q4: jax.Array,
                         q2: jax.Array, sm: jax.Array,
-                        interpret: bool) -> jax.Array:
+                        interpret: bool, variant: str = "cur") -> jax.Array:
     B, KA = xpa.shape
     K = (KA // TKA6) * TK
     N = q4.shape[1]
     TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q6K))
     in_specs, out_spec = _q6k_specs(B, TN)
     call = stacked_pallas_call(
-        functools.partial(_q6k_matmul_kernel, interpret=interpret),
+        functools.partial(_q6k_matmul_kernel, interpret=interpret,
+                          variant=variant),
         grid=(N // TN, K // TK),
         in_specs=in_specs,
         out_spec=out_spec,
@@ -319,11 +334,11 @@ def _q6k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q4: jax.Array,
     return call(idx, xpa, q4, q2, sm)
 
 
-@functools.lru_cache(maxsize=4)
-def _q6k_2d_stacked_partitioned(interpret: bool):
+@functools.lru_cache(maxsize=8)
+def _q6k_2d_stacked_partitioned(interpret: bool, variant: str = "cur"):
     return stacked_partitioned(
-        _q6k_2d_stacked_raw, "i, b k, l n j, l n p, l t n m -> b n",
-        interpret)
+        functools.partial(_q6k_2d_stacked_raw, variant=variant),
+        "i, b k, l n j, l n p, l t n m -> b n", interpret)
 
 
 def q6k_matmul_stacked(x: jax.Array, w: dict, idx,
@@ -333,7 +348,9 @@ def q6k_matmul_stacked(x: jax.Array, w: dict, idx,
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x6(permute_x6(x).reshape(-1, K).astype(jnp.bfloat16))
-    fn = _q6k_2d_stacked_partitioned(_interpret(interpret))
+    fn = _q6k_2d_stacked_partitioned(
+        _interpret(interpret),
+        _env_variant("LFKT_Q6K_KERNEL", ("cur", "parfloor")))
     i1 = jnp.asarray(idx, jnp.int32).reshape(1)
     y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws),
                      xpa, w["q4"], w["q2"], w["sm6"])
@@ -346,6 +363,8 @@ def q6k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x6(permute_x6(x).reshape(-1, K).astype(jnp.bfloat16))
-    fn = _q6k_2d_partitioned(_interpret(interpret))
+    fn = _q6k_2d_partitioned(
+        _interpret(interpret),
+        _env_variant("LFKT_Q6K_KERNEL", ("cur", "parfloor")))
     y = batched_rows(fn, xpa, w["q4"], w["q2"], w["sm6"])
     return y.reshape(*lead, -1).astype(x.dtype)

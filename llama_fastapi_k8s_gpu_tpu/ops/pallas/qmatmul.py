@@ -194,22 +194,22 @@ def dequant_ref(w: dict) -> jax.Array:
 # kernel
 # ---------------------------------------------------------------------------
 
-def _kernel_variant() -> str:
-    """LFKT_Q4K_KERNEL: ``cur`` (default) | ``resplit``.  Both compute
-    bit-identical planes; they differ only in the VPU dependency graph of
-    the low-nibble reconstruction (see kernel body).  Read at trace time —
-    a process-level knob for kernel A/B on hardware, not a runtime switch."""
+def _env_variant(name: str, allowed: tuple) -> str:
+    """Read a kernel-variant env knob, failing loud on typos (an A/B run
+    must never silently compare the default against itself).  The value is
+    threaded into every jit/lru cache key, so changing the env between
+    calls re-traces instead of silently reusing the old program.  Shared
+    by the Q4_K (LFKT_Q4K_KERNEL) and Q6_K (LFKT_Q6K_KERNEL) kernels."""
     import os
 
-    v = os.environ.get("LFKT_Q4K_KERNEL", "cur").strip().lower()
-    if v not in ("cur", "resplit"):
-        # an A/B run with a typo'd value must fail loud, not compare
-        # the default against itself
-        raise ValueError(f"LFKT_Q4K_KERNEL must be cur|resplit, got {v!r}")
+    v = os.environ.get(name, allowed[0]).strip().lower()
+    if v not in allowed:
+        raise ValueError(f"{name} must be {'|'.join(allowed)}, got {v!r}")
     return v
 
 
-def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret):
+def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret,
+                       variant="cur"):
     # xpa (B, TKA) bf16 permuted+augmented; qs (TN, TK/2) int8;
     # sm (1, TN, 128) bf16
     TN = qs_ref.shape[0]
@@ -224,7 +224,7 @@ def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret):
 
         sc_exp = pltpu.repeat(sc2, TK // 256, axis=1).astype(jnp.float32)
     h = jnp.floor(v * 0.0625)                         # hi − 8
-    if _kernel_variant() == "resplit":
+    if variant == "resplit":
         # lsc = v·sc − 16·(h·sc): all three f32 quantities are exact
         # (v, h ≤ 8-bit ints × bf16 scale fits f32), so the cancellation
         # reproduces l·sc EXACTLY — bit-identical planes to the `cur`
@@ -316,14 +316,15 @@ def plain_pallas_call(kernel, grid, in_specs, out_spec, out_shape,
 
 
 def _q4k_2d_raw(xpa: jax.Array, qs: jax.Array, sm: jax.Array,
-                interpret: bool) -> jax.Array:
+                interpret: bool, variant: str = "cur") -> jax.Array:
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = qs.shape[0]
     TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q4K))
     in_specs, out_spec = _q4k_specs(B, TN)
     return plain_pallas_call(
-        functools.partial(_q4k_matmul_kernel, interpret=interpret),
+        functools.partial(_q4k_matmul_kernel, interpret=interpret,
+                          variant=variant),
         (N // TN, K // TK), in_specs, out_spec,
         jax.ShapeDtypeStruct((B, N), jnp.float32), interpret,
     )(xpa, qs, sm)
@@ -337,7 +338,7 @@ def _spec_axis(sharding, dim: int):
 
 
 @functools.lru_cache(maxsize=4)
-def _q4k_2d_partitioned(interpret: bool):
+def _q4k_2d_partitioned(interpret: bool, variant: str = "cur"):
     """The 2D fused matmul with a GSPMD partitioning rule: tp-sharded
     ``qs``/``sm`` (N dim) compute locally and the output comes back N-sharded
     — no all-gather of the quantized weights (VERDICT r1 #5; previously a
@@ -353,7 +354,7 @@ def _q4k_2d_partitioned(interpret: bool):
 
     @custom_partitioning
     def fn(xpa, qs, sm):
-        return _q4k_2d_raw(xpa, qs, sm, interpret)
+        return _q4k_2d_raw(xpa, qs, sm, interpret, variant)
 
     def partition(mesh, arg_shapes, result_shape):
         xp_s, qs_s, sm_s = (a.sharding for a in arg_shapes)
@@ -367,7 +368,7 @@ def _q4k_2d_partitioned(interpret: bool):
         result_sharding = NamedSharding(mesh, P(rows, n_ax))
 
         def lower(xpa, qs, sm):
-            return _q4k_2d_raw(xpa, qs, sm, interpret)
+            return _q4k_2d_raw(xpa, qs, sm, interpret, variant)
 
         return mesh, lower, result_sharding, arg_shardings
 
@@ -460,14 +461,16 @@ def stacked_pallas_call(kernel, grid, in_specs, out_spec, out_shape,
 
 
 def _q4k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, qs: jax.Array,
-                        sm: jax.Array, interpret: bool) -> jax.Array:
+                        sm: jax.Array, interpret: bool,
+                        variant: str = "cur") -> jax.Array:
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = qs.shape[1]
     TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q4K))
     in_specs, out_spec = _q4k_specs(B, TN)
     call = stacked_pallas_call(
-        functools.partial(_q4k_matmul_kernel, interpret=interpret),
+        functools.partial(_q4k_matmul_kernel, interpret=interpret,
+                          variant=variant),
         grid=(N // TN, K // TK),
         in_specs=in_specs,
         out_spec=out_spec,
@@ -556,10 +559,11 @@ def stacked_partitioned(raw_fn, sharding_rule: str, interpret: bool):
     return jax.jit(rows_vmappable(fn, xpa_pos=1))
 
 
-@functools.lru_cache(maxsize=4)
-def _q4k_2d_stacked_partitioned(interpret: bool):
+@functools.lru_cache(maxsize=8)
+def _q4k_2d_stacked_partitioned(interpret: bool, variant: str = "cur"):
     return stacked_partitioned(
-        _q4k_2d_stacked_raw, "i, b k, l n j, l t n m -> b n", interpret)
+        functools.partial(_q4k_2d_stacked_raw, variant=variant),
+        "i, b k, l n j, l t n m -> b n", interpret)
 
 
 def q4k_matmul_stacked(x: jax.Array, w: dict, idx,
@@ -570,7 +574,8 @@ def q4k_matmul_stacked(x: jax.Array, w: dict, idx,
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
-    fn = _q4k_2d_stacked_partitioned(_interpret(interpret))
+    fn = _q4k_2d_stacked_partitioned(
+        _interpret(interpret), _env_variant("LFKT_Q4K_KERNEL", ("cur", "resplit")))
     i1 = jnp.asarray(idx, jnp.int32).reshape(1)
     y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws), xpa, w["qs"], w["sm"])
     return y.reshape(*lead, -1).astype(x.dtype)
@@ -612,6 +617,7 @@ def q4k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
     lead = x.shape[:-1]
     xpa = augment_x(
         permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
-    fn = _q4k_2d_partitioned(_interpret(interpret))
+    fn = _q4k_2d_partitioned(
+        _interpret(interpret), _env_variant("LFKT_Q4K_KERNEL", ("cur", "resplit")))
     y = batched_rows(fn, xpa, w["qs"], w["sm"])
     return y.reshape(*lead, -1).astype(x.dtype)

@@ -169,3 +169,27 @@ def test_q6k_params_shard_over_mesh():
     mesh = make_mesh(dp=2, tp=2, sp=2)
     sharded = shard_params(params, mesh)
     assert sharded["layers"]["wq"]["q4"].shape == params["layers"]["wq"]["q4"].shape
+
+
+def test_parfloor_variant_bit_identical(monkeypatch):
+    """LFKT_Q6K_KERNEL=parfloor must produce BIT-identical output: its
+    independent floors compute the same exact f32 integers as the serial
+    remainder chain."""
+    import numpy as np
+
+    from llama_fastapi_k8s_gpu_tpu.gguf.quants import quant_q6_k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas import q6matmul as qm
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import prep_q6k, q6k_matmul
+
+    rng = np.random.default_rng(1)
+    n, k = 64, 2048
+    w = (rng.standard_normal((n, k)) * 0.05).astype(np.float32)
+    wd = prep_q6k(quant_q6_k(w.reshape(-1)), n, k)
+    x = jnp.asarray(rng.standard_normal((4, k)), jnp.bfloat16)
+    # the variant is part of the builder cache key, so flipping the env
+    # between calls re-traces without any cache_clear choreography
+    monkeypatch.delenv("LFKT_Q6K_KERNEL", raising=False)
+    a = np.asarray(q6k_matmul(x, wd, interpret=True))
+    monkeypatch.setenv("LFKT_Q6K_KERNEL", "parfloor")
+    b = np.asarray(q6k_matmul(x, wd, interpret=True))
+    assert np.array_equal(a, b)

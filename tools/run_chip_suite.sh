@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-4 chip measurement suite. Run ALONE (single-session device tunnel);
+# each step is its own process and must fully exit before the next starts.
+# Artifacts land in docs/bench/ with today's date.
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%F)
+OUT=docs/bench
+mkdir -p "$OUT"
+
+step() {
+  local name="$1"; shift
+  echo "=== $name ($(date +%T)) ===" >&2
+  "$@" > "$OUT/_tmp.$name.json" 2> "$OUT/_tmp.$name.err"
+  local rc=$?
+  tail -1 "$OUT/_tmp.$name.json" > "$OUT/${name}_${TS}.json"
+  echo "rc=$rc $(head -c 200 "$OUT/${name}_${TS}.json")" >&2
+  sleep 5
+}
+
+# 1) headline q4km grid, current kernel
+step bench_q4km_cur python bench.py
+# 2) restructured-kernel A/B (bit-identical math, shallower VPU graphs)
+step bench_q4km_resplit env LFKT_Q4K_KERNEL=resplit python bench.py
+step bench_q4km_resplit_parfloor env LFKT_Q4K_KERNEL=resplit LFKT_Q6K_KERNEL=parfloor python bench.py
+# 3) cold start on the real 5.9 GB file (native packers + phase split)
+step coldstart env LFKT_BENCH_COLDSTART=1 LFKT_COLDSTART_REUSE=1 python bench.py
+# 4) server TTFT, short + full-context bucket
+step bench_server_short python bench_server.py
+step bench_server_fullctx env LFKT_BENCH_FULLCTX=1 python bench_server.py
+# 5) 8-lane aggregate with budgeted multi-admission
+step bench_server_batch8 env LFKT_BENCH_BATCH=8 python bench_server.py
+# 6) spec under lanes (acceptance telemetry; synthetic logits => low hits)
+step bench_server_batch8_spec env LFKT_BENCH_BATCH=8 LFKT_SPEC_DECODE=lookup python bench_server.py
+# 7) 8k long-context preset
+step bench_8k env LFKT_BENCH_PRESET=llama3-8b-8k python bench.py
+echo "=== suite done ($(date +%T)) ===" >&2
