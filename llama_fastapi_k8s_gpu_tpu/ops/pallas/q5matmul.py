@@ -38,6 +38,7 @@ from .qmatmul import (
     TK,
     TKA,
     _SUBS,
+    _env_variant,
     _interpret,
     _pick_tn,
     _spec_axis,
@@ -140,19 +141,31 @@ def dequant_ref5(w: dict) -> jax.Array:
 # kernel
 # ---------------------------------------------------------------------------
 
-def _q5k_matmul_kernel(xpa_ref, q5s_ref, q5h_ref, sm_ref, o_ref, *, interpret):
+def _q5k_matmul_kernel(xpa_ref, q5s_ref, q5h_ref, sm_ref, o_ref, *, interpret,
+                       variant="cur"):
     TN = q5s_ref.shape[0]
     v4 = q5s_ref[...].astype(jnp.float32)             # (TN, TK/2)
     h = jnp.floor(v4 * 0.0625)
     l = v4 - h * 16.0
 
     u = q5h_ref[...].astype(jnp.float32) + 128.0      # (TN, TK/8)
-    bits = []
-    for j in range(7, -1, -1):                        # bit7 .. bit0
-        bj = jnp.floor(u * (1.0 / (1 << j)))
-        u = u - bj * float(1 << j)
-        bits.append(bj)
-    hb = jnp.concatenate(list(reversed(bits)), axis=1)  # (TN, TK) col-major
+    if variant == "parfloor":
+        # bit_j = floor(u/2^j) − 2·floor(u/2^(j+1)): independent floors
+        # (depth-2 graph, same exact f32 integers → bit-identical) instead
+        # of the serial remainder chain (depth-14).  Endpoints need no
+        # floor: floor(u/1) = u and floor(u/256) = 0 for u ∈ [0,255].
+        fl = [None] + [jnp.floor(u * (1.0 / (1 << j))) for j in range(1, 8)]
+        bits = ([u - 2.0 * fl[1]]
+                + [fl[j] - 2.0 * fl[j + 1] for j in range(1, 7)]
+                + [fl[7]])
+        hb = jnp.concatenate(bits, axis=1)            # (TN, TK) col-major
+    else:
+        bits = []
+        for j in range(7, -1, -1):                    # bit7 .. bit0
+            bj = jnp.floor(u * (1.0 / (1 << j)))
+            u = u - bj * float(1 << j)
+            bits.append(bj)
+        hb = jnp.concatenate(list(reversed(bits)), axis=1)  # (TN, TK)
 
     sm = sm_ref[...].reshape(TN, 128)
     sc, mn = sm[:, :_SUBS], sm[:, _SUBS:]
@@ -204,21 +217,23 @@ def _q5k_specs(B: int, TN: int):
 
 
 def _q5k_2d_raw(xpa: jax.Array, q5s: jax.Array, q5h: jax.Array,
-                sm: jax.Array, interpret: bool) -> jax.Array:
+                sm: jax.Array, interpret: bool,
+                variant: str = "cur") -> jax.Array:
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = q5s.shape[0]
     TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q5K))
     in_specs, out_spec = _q5k_specs(B, TN)
     return plain_pallas_call(
-        functools.partial(_q5k_matmul_kernel, interpret=interpret),
+        functools.partial(_q5k_matmul_kernel, interpret=interpret,
+                          variant=variant),
         (N // TN, K // TK), in_specs, out_spec,
         jax.ShapeDtypeStruct((B, N), jnp.float32), interpret,
     )(xpa, q5s, q5h, sm)
 
 
 @functools.lru_cache(maxsize=4)
-def _q5k_2d_partitioned(interpret: bool):
+def _q5k_2d_partitioned(interpret: bool, variant: str = "cur"):
     """GSPMD rule mirroring the Q4_K kernel's: partition over N (and rows),
     never over K; tp-sharded weights compute locally."""
     from jax.experimental.custom_partitioning import custom_partitioning
@@ -226,7 +241,7 @@ def _q5k_2d_partitioned(interpret: bool):
 
     @custom_partitioning
     def fn(xpa, q5s, q5h, sm):
-        return _q5k_2d_raw(xpa, q5s, q5h, sm, interpret)
+        return _q5k_2d_raw(xpa, q5s, q5h, sm, interpret, variant)
 
     def partition(mesh, arg_shapes, result_shape):
         xp_s, qs_s, qh_s, sm_s = (a.sharding for a in arg_shapes)
@@ -241,7 +256,7 @@ def _q5k_2d_partitioned(interpret: bool):
         result_sharding = NamedSharding(mesh, P(rows, n_ax))
 
         def lower(xpa, q5s, q5h, sm):
-            return _q5k_2d_raw(xpa, q5s, q5h, sm, interpret)
+            return _q5k_2d_raw(xpa, q5s, q5h, sm, interpret, variant)
 
         return mesh, lower, result_sharding, arg_shardings
 
@@ -260,14 +275,15 @@ def _q5k_2d_partitioned(interpret: bool):
 
 def _q5k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q5s: jax.Array,
                         q5h: jax.Array, sm: jax.Array,
-                        interpret: bool) -> jax.Array:
+                        interpret: bool, variant: str = "cur") -> jax.Array:
     B, KA = xpa.shape
     K = (KA // TKA) * TK
     N = q5s.shape[1]
     TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q5K))
     in_specs, out_spec = _q5k_specs(B, TN)
     call = stacked_pallas_call(
-        functools.partial(_q5k_matmul_kernel, interpret=interpret),
+        functools.partial(_q5k_matmul_kernel, interpret=interpret,
+                          variant=variant),
         grid=(N // TN, K // TK),
         in_specs=in_specs,
         out_spec=out_spec,
@@ -278,10 +294,10 @@ def _q5k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q5s: jax.Array,
 
 
 @functools.lru_cache(maxsize=4)
-def _q5k_2d_stacked_partitioned(interpret: bool):
+def _q5k_2d_stacked_partitioned(interpret: bool, variant: str = "cur"):
     return stacked_partitioned(
-        _q5k_2d_stacked_raw, "i, b k, l n j, l n p, l t n m -> b n",
-        interpret)
+        functools.partial(_q5k_2d_stacked_raw, variant=variant),
+        "i, b k, l n j, l n p, l t n m -> b n", interpret)
 
 
 def q5k_matmul_stacked(x: jax.Array, w: dict, idx,
@@ -291,7 +307,9 @@ def q5k_matmul_stacked(x: jax.Array, w: dict, idx,
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
-    fn = _q5k_2d_stacked_partitioned(_interpret(interpret))
+    fn = _q5k_2d_stacked_partitioned(
+        _interpret(interpret),
+        _env_variant("LFKT_Q5K_KERNEL", ("cur", "parfloor")))
     i1 = jnp.asarray(idx, jnp.int32).reshape(1)
     y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws),
                      xpa, w["q5s"], w["q5h"], w["sm5"])
@@ -304,6 +322,8 @@ def q5k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
-    fn = _q5k_2d_partitioned(_interpret(interpret))
+    fn = _q5k_2d_partitioned(
+        _interpret(interpret),
+        _env_variant("LFKT_Q5K_KERNEL", ("cur", "parfloor")))
     y = batched_rows(fn, xpa, w["q5s"], w["q5h"], w["sm5"])
     return y.reshape(*lead, -1).astype(x.dtype)

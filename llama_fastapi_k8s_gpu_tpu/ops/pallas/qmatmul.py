@@ -199,7 +199,7 @@ def _env_variant(name: str, allowed: tuple) -> str:
     must never silently compare the default against itself).  The value is
     threaded into every jit/lru cache key, so changing the env between
     calls re-traces instead of silently reusing the old program.  Shared
-    by the Q4_K (LFKT_Q4K_KERNEL) and Q6_K (LFKT_Q6K_KERNEL) kernels."""
+    by every fused kernel's LFKT_Q*_KERNEL knob."""
     import os
 
     v = os.environ.get(name, allowed[0]).strip().lower()

@@ -110,3 +110,24 @@ def test_q5k_probe_passes():
     from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import probe_fused_q5k
 
     assert probe_fused_q5k() is None
+
+
+def test_parfloor_variant_bit_identical(monkeypatch):
+    """LFKT_Q5K_KERNEL=parfloor must produce BIT-identical output: its
+    independent hi-bit floors compute the same exact f32 integers as the
+    serial remainder chain."""
+    import numpy as np
+
+    from llama_fastapi_k8s_gpu_tpu.gguf.quants import quant_q5_k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q5matmul import prep_q5k, q5k_matmul
+
+    rng = np.random.default_rng(2)
+    n, k = 64, 2048
+    w = (rng.standard_normal((n, k)) * 0.05).astype(np.float32)
+    wd = prep_q5k(quant_q5_k(w.reshape(-1)), n, k)
+    x = jnp.asarray(rng.standard_normal((4, k)), jnp.bfloat16)
+    monkeypatch.delenv("LFKT_Q5K_KERNEL", raising=False)
+    a = np.asarray(q5k_matmul(x, wd, interpret=True))
+    monkeypatch.setenv("LFKT_Q5K_KERNEL", "parfloor")
+    b = np.asarray(q5k_matmul(x, wd, interpret=True))
+    assert np.array_equal(a, b)
