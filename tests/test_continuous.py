@@ -441,3 +441,31 @@ def test_scheduler_stats_surface(cengine):
         time.sleep(0.05)
     assert cengine.scheduler_stats()["lanes_live"] == 0
     assert cengine.scheduler_stats()["pending"] == 0
+
+
+def test_outputs_independent_of_adm_budget(tmp_path):
+    """The admission budget changes WHEN requests are admitted, never WHAT
+    they produce: a wave of greedy requests must yield identical text at
+    budget=1 (one slice per iteration, the round-3 behavior) and the
+    default multi-admission budget."""
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    prompts = [[{"role": "user", "content": f"budget wave {i} " * (1 + i % 3)}]
+               for i in range(8)]
+
+    def run(budget):
+        eng = ContinuousEngine(path, dp=2, tp=2, batch_size=4, n_ctx=128,
+                               decode_chunk=4, max_gen_tokens=16,
+                               prefill_buckets=(32, 64, 128),
+                               adm_budget=budget)
+        try:
+            if budget == 1:     # bypass the max(prefill_chunk, ...) clamp
+                eng._adm_budget = 1
+            futs = [eng.submit(p, temperature=0.0, max_tokens=8)
+                    for p in prompts]
+            return [f.result(timeout=300)["choices"][0]["message"]["content"]
+                    for f in futs]
+        finally:
+            eng.shutdown()
+
+    assert run(1) == run(512)
