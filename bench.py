@@ -15,8 +15,8 @@ stale process holding it makes ``jax.devices()`` fail fast (UNAVAILABLE) or
 hang forever.  The parent process therefore never touches jax itself: it
 spawns the real bench as a child, enforces a backend-init deadline (the
 child reports init on stderr) and a total deadline, kills hung children,
-and retries with backoff.  Tune via LFKT_BENCH_ATTEMPTS (default 5),
-LFKT_BENCH_INIT_TIMEOUT (s, default 180), LFKT_BENCH_TOTAL_TIMEOUT
+and retries with backoff.  Tune via LFKT_BENCH_ATTEMPTS (default 3),
+LFKT_BENCH_INIT_TIMEOUT (s, default 420), LFKT_BENCH_TOTAL_TIMEOUT
 (s, default 1500), LFKT_BENCH_BACKOFF (s, first gap, default 10, doubles).
 
 The model is the real 8B architecture (models/config.py LLAMA3_8B) with
@@ -669,8 +669,13 @@ def main() -> None:
             return default
 
     _preflight_warn()
-    attempts = max(1, int(env_num("LFKT_BENCH_ATTEMPTS", 5)))
-    init_timeout = env_num("LFKT_BENCH_INIT_TIMEOUT", 180)
+    # Fewer, longer attempts (round-4 lesson): the device grant can queue
+    # for many minutes behind a stale session, and every child killed at
+    # its init deadline becomes ANOTHER stale claimant that pushes the
+    # grant further out.  3 x 420 s covers the same wall clock as the old
+    # 5 x 180 s with two fewer kills.
+    attempts = max(1, int(env_num("LFKT_BENCH_ATTEMPTS", 3)))
+    init_timeout = env_num("LFKT_BENCH_INIT_TIMEOUT", 420)
     total_timeout = env_num("LFKT_BENCH_TOTAL_TIMEOUT", 1500)
     backoff = env_num("LFKT_BENCH_BACKOFF", 10)
     # hard cap across ALL attempts+backoffs, so an external harness timeout
