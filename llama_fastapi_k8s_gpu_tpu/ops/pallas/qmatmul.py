@@ -208,6 +208,9 @@ def _env_variant(name: str, allowed: tuple) -> str:
     return v
 
 
+Q4K_VARIANTS = ("cur", "resplit", "vbf32", "onedot")
+
+
 def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret,
                        variant="cur"):
     # xpa (B, TKA) bf16 permuted+augmented; qs (TN, TK/2) int8;
@@ -224,6 +227,38 @@ def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret,
 
         sc_exp = pltpu.repeat(sc2, TK // 256, axis=1).astype(jnp.float32)
     h = jnp.floor(v * 0.0625)                         # hi − 8
+    corr = jnp.concatenate([-mn, sc * 8.0], axis=1).astype(jnp.bfloat16)
+    xpa = xpa_ref[...]
+
+    if variant == "vbf32":
+        # Activation-side nibble recombination, f32 planes:
+        #   y = x_lo·(v·sc) + (x_hi − 16·x_lo)·(h·sc)
+        # Per weight only 2 multiplies + the floor — no reconstruction, no
+        # bf16 casts.  The two terms carry 16× the result's magnitude and
+        # cancel, so the planes stay f32 (v·sc and h·sc are EXACT in f32:
+        # ≤8-bit int × bf16 scale needs ≤16 mantissa bits) and the dots run
+        # at precision=HIGH (bf16x3 — f32-accurate products; HIGHEST hangs
+        # Mosaic remote-compile on this libtpu).  Residual error ~16·2⁻²²
+        # per term — below the bf16 activation rounding both variants share.
+        # The rejected `vb` ablation was this with bf16 planes: 3.3% rms.
+        a_v = v * sc_exp
+        a_h = h * sc_exp
+        x_lo = xpa[:, : TK // 2].astype(jnp.float32)
+        x_hi = xpa[:, TK // 2: TK].astype(jnp.float32)
+        part = jax.lax.dot_general(
+            x_lo, a_v, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGH,
+            preferred_element_type=jnp.float32)
+        part += jax.lax.dot_general(
+            x_hi - 16.0 * x_lo, a_h, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGH,
+            preferred_element_type=jnp.float32)
+        part += jax.lax.dot_general(
+            xpa[:, TK:], corr, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        _q4k_accum(o_ref, part)
+        return
+
     if variant == "resplit":
         # lsc = v·sc − 16·(h·sc): all three f32 quantities are exact
         # (v, h ≤ 8-bit ints × bf16 scale fits f32), so the cancellation
@@ -233,13 +268,25 @@ def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret,
         a_hi_f = h * sc_exp
         a_lo = (v * sc_exp - 16.0 * a_hi_f).astype(jnp.bfloat16)
         a_hi = a_hi_f.astype(jnp.bfloat16)
-    else:
+    else:                                             # cur | onedot
         l = v - h * 16.0                              # lo
         a_lo = (l * sc_exp).astype(jnp.bfloat16)      # (TN, TK/2)
         a_hi = (h * sc_exp).astype(jnp.bfloat16)
-    corr = jnp.concatenate([-mn, sc * 8.0], axis=1).astype(jnp.bfloat16)
 
-    xpa = xpa_ref[...]
+    if variant == "onedot":
+        # One concatenated (TN, TK) plane, one MXU dot over the full tile
+        # (plus the corr dot) — same planes as `cur` bit-for-bit, trading
+        # a VMEM concat copy for fewer, larger matmuls.
+        a = jnp.concatenate([a_lo, a_hi], axis=1)     # (TN, TK)
+        part = jax.lax.dot_general(
+            xpa[:, :TK], a, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        part += jax.lax.dot_general(
+            xpa[:, TK:], corr, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        _q4k_accum(o_ref, part)
+        return
+
     part = jax.lax.dot_general(
         xpa[:, : TK // 2], a_lo, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -249,7 +296,10 @@ def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret,
     part += jax.lax.dot_general(
         xpa[:, TK:], corr, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
+    _q4k_accum(o_ref, part)
 
+
+def _q4k_accum(o_ref, part):
     @pl.when(pl.program_id(1) == 0)
     def _():
         o_ref[...] = jnp.zeros_like(o_ref)
@@ -575,7 +625,7 @@ def q4k_matmul_stacked(x: jax.Array, w: dict, idx,
     lead = x.shape[:-1]
     xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
     fn = _q4k_2d_stacked_partitioned(
-        _interpret(interpret), _env_variant("LFKT_Q4K_KERNEL", ("cur", "resplit")))
+        _interpret(interpret), _env_variant("LFKT_Q4K_KERNEL", Q4K_VARIANTS))
     i1 = jnp.asarray(idx, jnp.int32).reshape(1)
     y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws), xpa, w["qs"], w["sm"])
     return y.reshape(*lead, -1).astype(x.dtype)
@@ -618,6 +668,6 @@ def q4k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
     xpa = augment_x(
         permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
     fn = _q4k_2d_partitioned(
-        _interpret(interpret), _env_variant("LFKT_Q4K_KERNEL", ("cur", "resplit")))
+        _interpret(interpret), _env_variant("LFKT_Q4K_KERNEL", Q4K_VARIANTS))
     y = batched_rows(fn, xpa, w["qs"], w["sm"])
     return y.reshape(*lead, -1).astype(x.dtype)

@@ -177,3 +177,51 @@ def test_resplit_variant_bit_identical(monkeypatch):
     monkeypatch.setenv("LFKT_Q4K_KERNEL", "resplit")
     b = np.asarray(q4k_matmul(x, wd, interpret=True))
     assert np.array_equal(a, b)
+
+
+def test_onedot_variant_matches_default(monkeypatch):
+    """LFKT_Q4K_KERNEL=onedot computes the same bf16 planes as the default
+    but sums one 2048-length dot where the default sums two 1024-length
+    dots, so f32 accumulation ORDER differs — same products, near-equal
+    sums (1e-6, vs the 2e-2 quantization tolerance), not bit-identity."""
+    from llama_fastapi_k8s_gpu_tpu.gguf.quants import quant_q4_k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import prep_q4k, q4k_matmul
+
+    rng = np.random.default_rng(3)
+    n, k = 64, 2048
+    w = (rng.standard_normal((n, k)) * 0.05).astype(np.float32)
+    wd = prep_q4k(quant_q4_k(w.reshape(-1)), n, k)
+    x = jnp.asarray(rng.standard_normal((4, k)), jnp.bfloat16)
+    monkeypatch.delenv("LFKT_Q4K_KERNEL", raising=False)
+    a = np.asarray(q4k_matmul(x, wd, interpret=True))
+    monkeypatch.setenv("LFKT_Q4K_KERNEL", "onedot")
+    b = np.asarray(q4k_matmul(x, wd, interpret=True))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_vbf32_variant_beats_default_accuracy(monkeypatch):
+    """LFKT_Q4K_KERNEL=vbf32 recombines nibbles on the activation side with
+    f32 planes.  The rejected bf16-plane `vb` ablation blew up to 3.3% rms
+    (16×-magnitude bf16 terms cancelling); the f32-plane variant must show
+    NO such blowup: at least as close to the f32 dequant_ref oracle as the
+    bf16-plane default (whose plane rounding it eliminates — the residual
+    both share is the bf16 corr/xsum path)."""
+    from llama_fastapi_k8s_gpu_tpu.gguf.quants import quant_q4_k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import prep_q4k, q4k_matmul
+
+    rng = np.random.default_rng(5)
+    n, k = 64, 4096
+    w = (rng.standard_normal((n, k)) * 0.05).astype(np.float32)
+    wd = prep_q4k(quant_q4_k(w.reshape(-1)), n, k)
+    x = jnp.asarray(rng.standard_normal((4, k)), jnp.float32)
+    ref = np.asarray(
+        permute_x(x).astype(jnp.bfloat16).astype(jnp.float32) @ dequant_ref(wd).T)
+    monkeypatch.delenv("LFKT_Q4K_KERNEL", raising=False)
+    cur = np.asarray(q4k_matmul(x, wd, interpret=True))
+    monkeypatch.setenv("LFKT_Q4K_KERNEL", "vbf32")
+    got = np.asarray(q4k_matmul(x, wd, interpret=True))
+    err_cur = np.abs(cur - ref).max()
+    err_vb = np.abs(got - ref).max()
+    assert err_vb <= err_cur * 1.05, (err_vb, err_cur)
+    np.testing.assert_allclose(got, ref, rtol=2e-2,
+                               atol=2e-2 * float(np.abs(ref).max()))

@@ -1,0 +1,147 @@
+"""Fused dequant-matmul kernel A/B microbench (run ALONE on the chip).
+
+Times every LFKT_Q*_KERNEL variant of the fused kernels on the 8B decode
+shapes, against the int8 control and the HBM-bandwidth roofline, so kernel
+restructurings can be picked on data (VERDICT r3 #2: raise Q4_K from 57% of
+roofline toward the int8 path's 85%).  Recreates the /tmp harness the
+round-4 tunnel outage orphaned — in tools/ so it survives the container.
+
+Method: each (fmt, variant, shape, B) cell times a jitted x -> x-chained
+matvec (output reduced back into the input row so nothing hoists), double
+warm-up discarded (docs/PERF.md "Measurement hygiene"), then the mean of
+``iters`` chained steps.  Variant env knobs are flipped in-process — they
+are part of every jit cache key (ops/pallas/qmatmul.py:_env_variant).
+
+Prints one JSON object (diagnostics, not the driver bench contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HBM_GBPS = 819.0  # v5e HBM bandwidth (spec)
+
+# 8B Llama decode shapes (N, K): qkv-ish square, ffn up/gate, ffn down
+SHAPES = [(4096, 4096), (14336, 4096), (4096, 14336)]
+BATCHES = (1, 8)
+ITERS = 50
+
+from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import Q4K_VARIANTS
+
+VARIANTS = {
+    "q4k": Q4K_VARIANTS,
+    "q5k": ("cur", "parfloor"),
+    "q6k": ("cur", "parfloor"),
+    "q8": ("cur",),
+    "int8": ("cur",),
+}
+KNOB = {"q4k": "LFKT_Q4K_KERNEL", "q5k": "LFKT_Q5K_KERNEL",
+        "q6k": "LFKT_Q6K_KERNEL"}
+
+
+def weight_bytes(fmt: str, n: int, k: int) -> int:
+    """HBM bytes one matvec must read (weights; activations negligible)."""
+    if fmt == "q4k":                       # qs N*K/2 + sm (K/2048)*N*128*2
+        return n * k // 2 + (k // 2048) * n * 128 * 2
+    if fmt == "q5k":                       # q4 plane + hi-bit plane + sm
+        return n * k // 2 + n * k // 8 + (k // 2048) * n * 128 * 2
+    if fmt == "q6k":                       # 6 bit/w planes + bf16 scales/16
+        return n * k * 3 // 4 + (k // 16) * n * 2
+    if fmt == "q8":                        # int8 + bf16 scale per 32
+        return n * k + (k // 32) * n * 2
+    if fmt == "int8":                      # int8 + one bf16 scale per row
+        return n * k + n * 2
+    raise ValueError(fmt)
+
+
+def make_weight(fmt: str, n: int, k: int, rng) -> dict:
+    import importlib
+
+    # ops/__init__ re-exports the `linear` FUNCTION under the submodule's
+    # name, so plain attribute imports resolve to the function
+    L = importlib.import_module("llama_fastapi_k8s_gpu_tpu.ops.linear")
+
+    w = (rng.standard_normal((n, k)).astype(np.float32) * (k ** -0.5))
+    mk = {"q4k": L.make_linear_q4k, "q5k": L.make_linear_q5k,
+          "q6k": L.make_linear_q6k, "q8": L.make_linear_q8,
+          "int8": L.make_linear_int8}[fmt]
+    return jax.device_put(mk(w))
+
+
+def timed_chain(linear_fn, w, b: int, k: int, n: int, iters: int) -> float:
+    @jax.jit
+    def step(x):
+        y = linear_fn(x, w)                       # (B, N) bf16
+        # fold the output back into the input row so the chain serializes;
+        # the coupling must be non-zero or XLA folds it and dead-codes the
+        # matmul (tiny enough that x stays ~1 over the whole chain)
+        r = jnp.sum(y, axis=1, keepdims=True).astype(jnp.bfloat16)
+        return x + r * jnp.bfloat16(1e-8)
+
+    x = jnp.ones((b, k), jnp.bfloat16)
+    x = step(x); x.block_until_ready()            # compile
+    x = step(x); x.block_until_ready()            # second warm (slow-start)
+    for _ in range(3):
+        x = step(x)
+    x.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = step(x)
+    x.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    from llama_fastapi_k8s_gpu_tpu.ops.linear import linear
+
+    dev = jax.devices()[0]
+    out: dict = {"device": str(dev), "iters": ITERS, "hbm_gbps": HBM_GBPS}
+    rows = []
+    rng = np.random.default_rng(0)
+    fmts = [f for f in VARIANTS
+            if f in os.environ.get("KMB_FMTS", ",".join(VARIANTS)).split(",")]
+    for fmt in fmts:
+        for (n, k) in SHAPES:
+            w = make_weight(fmt, n, k, rng)
+            # bytes / (GB/s · 1e3) = bytes/s · 1e-9 · 1e6 = microseconds
+            roof_us = weight_bytes(fmt, n, k) / (HBM_GBPS * 1e3)
+            for var in VARIANTS[fmt]:
+                if fmt in KNOB:
+                    os.environ[KNOB[fmt]] = var
+                for b in BATCHES:
+                    try:
+                        dt = timed_chain(linear, w, b, k, n, ITERS)
+                    except Exception as e:  # variant may not compile on-chip
+                        rows.append({"fmt": fmt, "variant": var, "n": n,
+                                     "k": k, "b": b,
+                                     "error": str(e)[:200]})
+                        print(f"FAIL {fmt}/{var} ({n},{k}) B={b}: "
+                              f"{str(e)[:120]}", file=sys.stderr, flush=True)
+                        continue
+                    rows.append({
+                        "fmt": fmt, "variant": var, "n": n, "k": k, "b": b,
+                        "us": round(dt * 1e6, 1),
+                        "roofline_us": round(roof_us, 1),
+                        "pct_roofline": round(100 * roof_us / (dt * 1e6), 1),
+                    })
+                    print(f"{fmt}/{var} ({n},{k}) B={b}: "
+                          f"{dt*1e6:.1f} us ({100*roof_us/(dt*1e6):.0f}% roof)",
+                          file=sys.stderr, flush=True)
+                if fmt in KNOB:
+                    del os.environ[KNOB[fmt]]
+            del w
+    out["rows"] = rows
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
