@@ -361,6 +361,10 @@ def child_main() -> None:
         # if no backend is initialized yet (same defense as tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
 
+    from llama_fastapi_k8s_gpu_tpu.utils.jaxcache import setup_compile_cache
+
+    setup_compile_cache()
+
     from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B, ModelConfig
     from llama_fastapi_k8s_gpu_tpu.models.generate import (
         generate_chunk_jit,

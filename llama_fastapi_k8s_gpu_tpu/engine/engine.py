@@ -36,6 +36,7 @@ from ..models.llama import init_cache
 from ..models.params import load_params, synth_params
 from ..sampling.sample import SamplingParams, sampling_tensors, seed_window
 from ..tokenizer import apply_chat_template, detect_chat_template, tokenizer_from_gguf
+from ..utils.jaxcache import setup_compile_cache
 from ..utils.tracing import maybe_profile
 
 logger = logging.getLogger(__name__)
@@ -43,20 +44,6 @@ logger = logging.getLogger(__name__)
 DEFAULT_BUCKETS = (128, 256, 512, 1024)
 
 
-def _setup_compile_cache():
-    """Persistent XLA compilation cache (SURVEY.md §5 "Checkpoint / resume"):
-    cuts the jit-warmup cost of a pod restart from minutes to seconds.  Off
-    unless LFKT_COMPILE_CACHE_DIR is set."""
-    import os
-
-    d = os.environ.get("LFKT_COMPILE_CACHE_DIR")
-    if not d:
-        return
-    try:
-        jax.config.update("jax_compilation_cache_dir", d)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception as e:  # noqa: BLE001 — older jax: serve without the cache
-        logger.warning("compilation cache unavailable: %s", e)
 
 
 class _TextEmitter:
@@ -158,7 +145,7 @@ class Engine:
         #: per-request timings also ride in each response dict under
         #: "lfkt_timings" so callers never need this shared field.
         self.last_timings: dict | None = None
-        _setup_compile_cache()
+        setup_compile_cache()
 
         if _parts is not None:
             self.params, self.cfg, self.tokenizer, self.template_kind = _parts

@@ -53,6 +53,8 @@ from .qmatmul import (
     stacked_partitioned,
 )
 
+Q5K_VARIANTS = ("cur", "parfloor")
+
 q5k_compatible = q4k_compatible  # same divisibility classes
 
 
@@ -309,7 +311,7 @@ def q5k_matmul_stacked(x: jax.Array, w: dict, idx,
     xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
     fn = _q5k_2d_stacked_partitioned(
         _interpret(interpret),
-        _env_variant("LFKT_Q5K_KERNEL", ("cur", "parfloor")))
+        _env_variant("LFKT_Q5K_KERNEL", Q5K_VARIANTS))
     i1 = jnp.asarray(idx, jnp.int32).reshape(1)
     y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws),
                      xpa, w["q5s"], w["q5h"], w["sm5"])
@@ -324,6 +326,6 @@ def q5k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
     xpa = augment_x(permute_x(x).reshape(-1, K).astype(jnp.bfloat16))
     fn = _q5k_2d_partitioned(
         _interpret(interpret),
-        _env_variant("LFKT_Q5K_KERNEL", ("cur", "parfloor")))
+        _env_variant("LFKT_Q5K_KERNEL", Q5K_VARIANTS))
     y = batched_rows(fn, xpa, w["q5s"], w["q5h"], w["sm5"])
     return y.reshape(*lead, -1).astype(x.dtype)

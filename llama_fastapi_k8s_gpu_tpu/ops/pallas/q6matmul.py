@@ -64,6 +64,8 @@ from .qmatmul import (
     stacked_partitioned,
 )
 
+Q6K_VARIANTS = ("cur", "parfloor")
+
 _SUBS6 = TK // 16    # 128 sub-blocks of 16 per k-tile
 TKA6 = TK + 256      # + [xsum_all(128) | xsum_hi(128)] correction columns
 
@@ -350,7 +352,7 @@ def q6k_matmul_stacked(x: jax.Array, w: dict, idx,
     xpa = augment_x6(permute_x6(x).reshape(-1, K).astype(jnp.bfloat16))
     fn = _q6k_2d_stacked_partitioned(
         _interpret(interpret),
-        _env_variant("LFKT_Q6K_KERNEL", ("cur", "parfloor")))
+        _env_variant("LFKT_Q6K_KERNEL", Q6K_VARIANTS))
     i1 = jnp.asarray(idx, jnp.int32).reshape(1)
     y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws),
                      xpa, w["q4"], w["q2"], w["sm6"])
@@ -365,6 +367,6 @@ def q6k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
     xpa = augment_x6(permute_x6(x).reshape(-1, K).astype(jnp.bfloat16))
     fn = _q6k_2d_partitioned(
         _interpret(interpret),
-        _env_variant("LFKT_Q6K_KERNEL", ("cur", "parfloor")))
+        _env_variant("LFKT_Q6K_KERNEL", Q6K_VARIANTS))
     y = batched_rows(fn, xpa, w["q4"], w["q2"], w["sm6"])
     return y.reshape(*lead, -1).astype(x.dtype)

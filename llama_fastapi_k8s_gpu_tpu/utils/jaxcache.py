@@ -1,0 +1,29 @@
+"""Persistent XLA compilation cache setup (SURVEY.md §5 "Checkpoint/resume").
+
+Cuts the jit-warmup cost of a process restart from minutes to seconds — the
+serving analogue of the reference's model-artifact reuse across pod restarts
+(reference helm/templates/deployment.yaml:26-49 initContainer).  Off unless
+LFKT_COMPILE_CACHE_DIR is set.  Shared by the Engine (engine/engine.py) and
+the bench children (bench.py / bench_server.py), whose per-step processes
+otherwise each pay the full remote-compile cost of the same programs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def setup_compile_cache() -> None:
+    d = os.environ.get("LFKT_COMPILE_CACHE_DIR")
+    if not d:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # noqa: BLE001 — older jax: serve without the cache
+        logger.warning("compilation cache unavailable: %s", e)

@@ -35,12 +35,14 @@ SHAPES = [(4096, 4096), (14336, 4096), (4096, 14336)]
 BATCHES = (1, 8)
 ITERS = 50
 
+from llama_fastapi_k8s_gpu_tpu.ops.pallas.q5matmul import Q5K_VARIANTS
+from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import Q6K_VARIANTS
 from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import Q4K_VARIANTS
 
 VARIANTS = {
     "q4k": Q4K_VARIANTS,
-    "q5k": ("cur", "parfloor"),
-    "q6k": ("cur", "parfloor"),
+    "q5k": Q5K_VARIANTS,
+    "q6k": Q6K_VARIANTS,
     "q8": ("cur",),
     "int8": ("cur",),
 }
@@ -107,8 +109,13 @@ def main() -> None:
     out: dict = {"device": str(dev), "iters": ITERS, "hbm_gbps": HBM_GBPS}
     rows = []
     rng = np.random.default_rng(0)
-    fmts = [f for f in VARIANTS
-            if f in os.environ.get("KMB_FMTS", ",".join(VARIANTS)).split(",")]
+    sel = [f for f in os.environ.get(
+        "KMB_FMTS", ",".join(VARIANTS)).split(",") if f]
+    bad = [f for f in sel if f not in VARIANTS]
+    if bad:  # fail loud — a typo'd A/B must not silently bench nothing
+        raise SystemExit(
+            f"KMB_FMTS: unknown format(s) {bad}; valid: {list(VARIANTS)}")
+    fmts = [f for f in VARIANTS if f in sel]
     for fmt in fmts:
         for (n, k) in SHAPES:
             w = make_weight(fmt, n, k, rng)
