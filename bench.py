@@ -240,6 +240,11 @@ def coldstart_main() -> None:
     serve one completion.  Reports write_s / load_s / compile+first_ttft_s,
     which gate the Helm startup-probe budget (helm/values.yaml)."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import logging
+
+    # surface the engine's load-phase INFO logs on stderr (the suite keeps
+    # per-step .err files; without this the phase attribution is silent)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     import numpy as np
 
     import jax
@@ -339,6 +344,7 @@ def coldstart_main() -> None:
         "first_request_s": round(first_req_s, 1),   # jit compile + generate
         "ttft_s_steady": timings.get("ttft_s"),
         "tokens_per_sec": timings.get("tokens_per_sec"),
+        "load_phases": getattr(eng, "load_phases", None),
         "device": str(dev),
     }
     print(json.dumps(result), flush=True)

@@ -147,6 +147,11 @@ class Engine:
         self.last_timings: dict | None = None
         setup_compile_cache()
 
+        #: coarse wall-clock attribution of model load (tokenizer build,
+        #: fused-kernel compile probes, weight prep+transfer) — surfaced
+        #: by the coldstart bench to direct startup-latency work; empty for
+        #: in-memory (_parts) engines
+        self.load_phases: dict = {}
         if _parts is not None:
             self.params, self.cfg, self.tokenizer, self.template_kind = _parts
             self.model_name = "in-memory"
@@ -155,7 +160,9 @@ class Engine:
             gf = GGUFFile(model_path)
             self.model_name = gf.metadata.get("general.name", model_path)
             self.cfg = ModelConfig.from_gguf(gf, n_ctx=n_ctx)
+            _pt = time.time()
             self.tokenizer = tokenizer_from_gguf(gf)
+            self.load_phases["tokenizer_s"] = round(time.time() - _pt, 1)
             if weight_format == "auto":
                 # bf16 params ≈ 2 bytes/weight; small models keep exact
                 # bf16.  Large models on TPU serve "q4k": Q4_K/Q6_K tensors
@@ -178,9 +185,16 @@ class Engine:
             fused_types = None
             if weight_format == "q4k":
                 present = {t.ggml_type for t in gf.tensors.values()}
+                _pt = time.time()
                 weight_format, fused_types = self._probe_fused_format(present)
+                self.load_phases["probes_s"] = round(time.time() - _pt, 1)
+            _pt = time.time()
+            sub: dict = {}
             self.params = load_params(gf, self.cfg, weight_format,
-                                      fused_types=fused_types)
+                                      fused_types=fused_types, phases_out=sub)
+            self.load_phases["params_s"] = round(time.time() - _pt, 1)
+            self.load_phases.update(
+                {f"params_{k}_s": round(v, 1) for k, v in sub.items()})
             self.template_kind = detect_chat_template(
                 gf.metadata.get("tokenizer.chat_template"), self.tokenizer
             )

@@ -66,7 +66,8 @@ def _stack(dicts: list[dict]) -> dict:
 
 def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
                 on_device: bool | None = None,
-                fused_types: frozenset | None = None) -> dict:
+                fused_types: frozenset | None = None,
+                phases_out: dict | None = None) -> dict:
     """Dequantize all tensors from ``gf`` into a stacked param pytree.
 
     ``on_device=True`` (default on TPU) routes quantized tensors through the
@@ -199,6 +200,10 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     phase_s["stack"] = _time.time() - t0
     logger.info("load_params phases: per-layer prep+transfer %.1fs, "
                 "stack %.1fs", phase_s["prep"], phase_s["stack"])
+    if phases_out is not None:
+        # caller-owned out-param (Engine.load_phases → coldstart bench JSON);
+        # no shared module state, so concurrent loads can't cross-report
+        phases_out.update(phase_s)
     return {
         "tok_emb": emb,
         "layers": stacked,

@@ -39,6 +39,7 @@ from .qmatmul import (
     TKA,
     _SUBS,
     _env_variant,
+    _lane_repeat,
     _interpret,
     _pick_tn,
     _spec_axis,
@@ -172,12 +173,7 @@ def _q5k_matmul_kernel(xpa_ref, q5s_ref, q5h_ref, sm_ref, o_ref, *, interpret,
     sm = sm_ref[...].reshape(TN, 128)
     sc, mn = sm[:, :_SUBS], sm[:, _SUBS:]
     sc2 = jnp.concatenate([sc, sc], axis=1)           # (TN, 128)
-    if interpret:
-        sc_exp = jnp.tile(sc2, (1, TK // 256)).astype(jnp.float32)
-    else:
-        from jax.experimental.pallas import tpu as pltpu
-
-        sc_exp = pltpu.repeat(sc2, TK // 256, axis=1).astype(jnp.float32)
+    sc_exp = _lane_repeat(sc2, TK // 256, interpret)
     sc16 = sc_exp * 16.0
     a_lo = (l * sc_exp + hb[:, : TK // 2] * sc16).astype(jnp.bfloat16)
     a_hi = (h * sc_exp + hb[:, TK // 2:] * sc16).astype(jnp.bfloat16)

@@ -46,14 +46,15 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
 from .qmatmul import (
     TK,
     _env_variant,
     _interpret,
+    _lane_repeat,
     _pick_tn,
+    _q4k_accum,
     _spec_axis,
     _tn_prefs_for,
     batched_rows,
@@ -64,7 +65,7 @@ from .qmatmul import (
     stacked_partitioned,
 )
 
-Q6K_VARIANTS = ("cur", "parfloor")
+Q6K_VARIANTS = ("cur", "parfloor", "vbf32")
 
 _SUBS6 = TK // 16    # 128 sub-blocks of 16 per k-tile
 TKA6 = TK + 256      # + [xsum_all(128) | xsum_hi(128)] correction columns
@@ -192,10 +193,18 @@ def _q6k_matmul_kernel(xpa_ref, q4_ref, q2_ref, sm_ref, o_ref, *, interpret,
     TN = q4_ref.shape[0]
     v4 = q4_ref[...].astype(jnp.float32)              # (TN, TK/2)
     h = jnp.floor(v4 * 0.0625)
-    l = v4 - h * 16.0
-    nib = jnp.concatenate([l, h], axis=1)             # (TN, TK); hi bias → corr
 
     u = q2_ref[...].astype(jnp.float32) + 128.0       # (TN, TK/4)
+
+    sm = sm_ref[...].reshape(TN, 128)                 # eff = d·sc
+    corr = jnp.concatenate([sm * -32.0, sm * 8.0], axis=1).astype(jnp.bfloat16)
+
+    if variant == "vbf32":
+        _q6k_vbf32_body(xpa_ref, v4, h, u, sm, corr, o_ref, interpret)
+        return
+
+    l = v4 - h * 16.0
+    nib = jnp.concatenate([l, h], axis=1)             # (TN, TK); hi bias → corr
     if variant == "parfloor":
         # all floors depend only on u (u ≤ 255 integer; /4,/16,/64 are
         # exact power-of-two scalings, so every quantity is an exact f32
@@ -215,18 +224,10 @@ def _q6k_matmul_kernel(xpa_ref, q4_ref, q2_ref, sm_ref, o_ref, *, interpret,
         c0 = r - 4.0 * c1
     crumb = jnp.concatenate([c0, c1, c2, c3], axis=1)  # (TN, TK)
 
-    sm = sm_ref[...].reshape(TN, 128)                 # eff = d·sc
-    if interpret:
-        eff = jnp.tile(sm, (1, TK // 128)).astype(jnp.float32)
-        eff16 = jnp.tile(sm * 16.0, (1, TK // 128)).astype(jnp.float32)
-    else:
-        from jax.experimental.pallas import tpu as pltpu
-
-        eff = pltpu.repeat(sm, TK // 128, axis=1).astype(jnp.float32)
-        eff16 = pltpu.repeat(sm * 16.0, TK // 128, axis=1).astype(jnp.float32)
+    eff = _lane_repeat(sm, TK // 128, interpret)
+    eff16 = _lane_repeat(sm * 16.0, TK // 128, interpret)
 
     a = (nib * eff + crumb * eff16).astype(jnp.bfloat16)
-    corr = jnp.concatenate([sm * -32.0, sm * 8.0], axis=1).astype(jnp.bfloat16)
 
     xpa = xpa_ref[...]
     part = jax.lax.dot_general(
@@ -235,12 +236,57 @@ def _q6k_matmul_kernel(xpa_ref, q4_ref, q2_ref, sm_ref, o_ref, *, interpret,
     part += jax.lax.dot_general(
         xpa[:, TK:], corr, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
+    _q4k_accum(o_ref, part)
 
-    @pl.when(pl.program_id(1) == 0)
-    def _():
-        o_ref[...] = jnp.zeros_like(o_ref)
 
-    o_ref[...] += part
+def _q6k_vbf32_body(xpa_ref, v4, h, u, sm, corr, o_ref, interpret):
+    """Activation-side recombination with f32 planes (Q6_K analogue of the
+    Q4_K ``vbf32`` variant, ops/pallas/qmatmul.py).
+
+    Nibbles: ``x_lo·(l·eff) + x_hi·(h·eff)`` rewritten through ``l = v4 −
+    16h`` as ``x_lo·(v4·eff) + (x_hi − 16·x_lo)·(h·eff)`` — no per-weight
+    reconstruction.  Crumbs: with partial floors ``f1 = ⌊u/4⌋``,
+    ``f2 = ⌊u/16⌋``, ``c3 = ⌊u/64⌋`` the base-4 digit sum telescopes,
+    ``Σⱼ xⱼ·cⱼ = x₀·u + (x₁−4x₀)·f1 + (x₂−4x₁)·f2 + (x₃−4x₂)·c3``, so no
+    digit is ever isolated.  Per packed byte: 1 floor + 2 muls (nibbles),
+    3 floors + 4 muls (4 crumbs) — vs the default's per-WEIGHT multiply,
+    add and bf16 cast.  All planes are exact f32 products (≤8-bit int ×
+    bf16 scale needs ≤16 mantissa bits); dots run at precision=HIGH so the
+    amplified-magnitude cancellations stay at f32 accuracy (residual
+    ~64·2⁻²² per term — below the shared bf16 corr path).
+
+    Scale alignment: a crumb byte's four columns ``b+512j`` and a nibble
+    byte's pair ``b, b+1024`` all share sub-block ``b % 128`` (512 and
+    1024 are multiples of 128), so one repeated ``sm`` plane serves every
+    term."""
+    eff_h = _lane_repeat(sm, (TK // 2) // 128, interpret)
+    eff_q = _lane_repeat(sm * 16.0, (TK // 4) // 128, interpret)
+
+    f1 = jnp.floor(u * 0.25)
+    f2 = jnp.floor(u * 0.0625)
+    c3 = jnp.floor(u * (1.0 / 64.0))
+
+    xpa = xpa_ref[...]
+    Q = TK // 4
+    x0 = xpa[:, 0 * Q: 1 * Q].astype(jnp.float32)
+    x1 = xpa[:, 1 * Q: 2 * Q].astype(jnp.float32)
+    x2 = xpa[:, 2 * Q: 3 * Q].astype(jnp.float32)
+    x3 = xpa[:, 3 * Q: 4 * Q].astype(jnp.float32)
+    x_lo = jnp.concatenate([x0, x1], axis=1)          # columns [0, TK/2)
+    x_hi = jnp.concatenate([x2, x3], axis=1)          # columns [TK/2, TK)
+
+    hi = jax.lax.Precision.HIGH
+    dot = functools.partial(
+        jax.lax.dot_general, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    part = dot(x_lo, v4 * eff_h, precision=hi)
+    part += dot(x_hi - 16.0 * x_lo, h * eff_h, precision=hi)
+    part += dot(x0, u * eff_q, precision=hi)
+    part += dot(x1 - 4.0 * x0, f1 * eff_q, precision=hi)
+    part += dot(x2 - 4.0 * x1, f2 * eff_q, precision=hi)
+    part += dot(x3 - 4.0 * x2, c3 * eff_q, precision=hi)
+    part += dot(xpa[:, TK:], corr)
+    _q4k_accum(o_ref, part)
 
 
 _TN_PREFS_Q6K = (256, 128)  # wider f32 intermediates than Q4_K: smaller TN

@@ -36,6 +36,7 @@ from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType
 from .qmatmul import (
+    _lane_repeat,
     TK,
     _interpret,
     _pick_tn,
@@ -95,12 +96,7 @@ def _q8_matmul_kernel(xp_ref, q8_ref, sm_ref, o_ref, *, interpret):
     TN = q8_ref.shape[0]
     v = q8_ref[...].astype(jnp.float32)               # (TN, TK)
     sm = sm_ref[...].reshape(TN, 128)
-    if interpret:
-        sc_exp = jnp.tile(sm, (1, TK // 128)).astype(jnp.float32)
-    else:
-        from jax.experimental.pallas import tpu as pltpu
-
-        sc_exp = pltpu.repeat(sm, TK // 128, axis=1).astype(jnp.float32)
+    sc_exp = _lane_repeat(sm, TK // 128, interpret)
     a = (v * sc_exp).astype(jnp.bfloat16)
     part = jax.lax.dot_general(
         xp_ref[...], a, (((1,), (1,)), ((), ())),

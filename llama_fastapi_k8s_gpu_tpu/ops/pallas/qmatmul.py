@@ -211,6 +211,17 @@ def _env_variant(name: str, allowed: tuple) -> str:
 Q4K_VARIANTS = ("cur", "resplit", "vbf32", "onedot")
 
 
+def _lane_repeat(v, times: int, interpret: bool):
+    """Expand a 128-lane per-sub-block vector over a k-tile by vreg tiling
+    (f32): ``jnp.tile`` in interpret mode, ``pltpu.repeat`` on TPU.  Shared
+    by every fused kernel's scale-plane expansion."""
+    if interpret:
+        return jnp.tile(v, (1, times)).astype(jnp.float32)
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.repeat(v, times, axis=1).astype(jnp.float32)
+
+
 def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret,
                        variant="cur"):
     # xpa (B, TKA) bf16 permuted+augmented; qs (TN, TK/2) int8;
@@ -220,12 +231,7 @@ def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret,
     sm = sm_ref[...].reshape(TN, 128)
     sc, mn = sm[:, :_SUBS], sm[:, _SUBS:]
     sc2 = jnp.concatenate([sc, sc], axis=1)           # (TN, 128)
-    if interpret:
-        sc_exp = jnp.tile(sc2, (1, TK // 256)).astype(jnp.float32)
-    else:
-        from jax.experimental.pallas import tpu as pltpu
-
-        sc_exp = pltpu.repeat(sc2, TK // 256, axis=1).astype(jnp.float32)
+    sc_exp = _lane_repeat(sc2, TK // 256, interpret)
     h = jnp.floor(v * 0.0625)                         # hi − 8
     corr = jnp.concatenate([-mn, sc * 8.0], axis=1).astype(jnp.bfloat16)
     xpa = xpa_ref[...]

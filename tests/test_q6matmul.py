@@ -193,3 +193,29 @@ def test_parfloor_variant_bit_identical(monkeypatch):
     monkeypatch.setenv("LFKT_Q6K_KERNEL", "parfloor")
     b = np.asarray(q6k_matmul(x, wd, interpret=True))
     assert np.array_equal(a, b)
+
+
+def test_vbf32_variant_beats_default_accuracy(monkeypatch):
+    """LFKT_Q6K_KERNEL=vbf32 (activation-side recombination, f32 planes,
+    telescoped crumb digits) must show no cancellation blowup: at least as
+    close to the f32 dequant_ref6 oracle as the bf16-plane default, and
+    inside the default's own quantization tolerance."""
+    from llama_fastapi_k8s_gpu_tpu.gguf.quants import quant_q6_k
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import prep_q6k, q6k_matmul
+
+    rng = np.random.default_rng(7)
+    n, k = 64, 4096
+    w = (rng.standard_normal((n, k)) * 0.05).astype(np.float32)
+    wd = prep_q6k(quant_q6_k(w.reshape(-1)), n, k)
+    x = jnp.asarray(rng.standard_normal((4, k)), jnp.float32)
+    ref = np.asarray(
+        permute_x6(x).astype(jnp.bfloat16).astype(jnp.float32) @ dequant_ref6(wd).T)
+    monkeypatch.delenv("LFKT_Q6K_KERNEL", raising=False)
+    cur = np.asarray(q6k_matmul(x, wd, interpret=True))
+    monkeypatch.setenv("LFKT_Q6K_KERNEL", "vbf32")
+    got = np.asarray(q6k_matmul(x, wd, interpret=True))
+    err_cur = np.abs(cur - ref).max()
+    err_vb = np.abs(got - ref).max()
+    assert err_vb <= err_cur * 1.05, (err_vb, err_cur)
+    np.testing.assert_allclose(got, ref, rtol=2e-2,
+                               atol=2e-2 * float(np.abs(ref).max()))

@@ -112,9 +112,10 @@ def main() -> None:
     sel = [f for f in os.environ.get(
         "KMB_FMTS", ",".join(VARIANTS)).split(",") if f]
     bad = [f for f in sel if f not in VARIANTS]
-    if bad:  # fail loud — a typo'd A/B must not silently bench nothing
-        raise SystemExit(
-            f"KMB_FMTS: unknown format(s) {bad}; valid: {list(VARIANTS)}")
+    if bad or not sel:  # fail loud — a typo'd (or empty) A/B must not
+        raise SystemExit(  # silently bench nothing
+            f"KMB_FMTS: unknown format(s) {bad or '(empty)'}; "
+            f"valid: {list(VARIANTS)}")
     fmts = [f for f in VARIANTS if f in sel]
     for fmt in fmts:
         for (n, k) in SHAPES:
