@@ -251,9 +251,10 @@ def _q6k_vbf32_body(xpa_ref, v4, h, u, sm, corr, o_ref, interpret):
     digit is ever isolated.  Per packed byte: 1 floor + 2 muls (nibbles),
     3 floors + 4 muls (4 crumbs) — vs the default's per-WEIGHT multiply,
     add and bf16 cast.  All planes are exact f32 products (≤8-bit int ×
-    bf16 scale needs ≤16 mantissa bits); dots run at precision=HIGH so the
-    amplified-magnitude cancellations stay at f32 accuracy (residual
-    ~64·2⁻²² per term — below the shared bf16 corr path).
+    bf16 scale needs ≤16 mantissa bits); the dots take f32 operands so the
+    amplified-magnitude cancellations stay at f32 accuracy IF the backend's
+    f32 dot is multi-pass (residual ~64·2⁻²² per term — below the shared
+    bf16 corr path); see the chip-gate note at the dot call below.
 
     Scale alignment: a crumb byte's four columns ``b+512j`` and a nibble
     byte's pair ``b, b+1024`` all share sub-block ``b % 128`` (512 and
@@ -275,16 +276,18 @@ def _q6k_vbf32_body(xpa_ref, v4, h, u, sm, corr, o_ref, interpret):
     x_lo = jnp.concatenate([x0, x1], axis=1)          # columns [0, TK/2)
     x_hi = jnp.concatenate([x2, x3], axis=1)          # columns [TK/2, TK)
 
-    hi = jax.lax.Precision.HIGH
+    # f32-operand dots; Mosaic rejects an explicit precision attr — see the
+    # Q4_K vbf32 note (qmatmul.py): the chip microbench's numerics
+    # cross-check gates whether its f32 lowering preserves the cancellation
     dot = functools.partial(
         jax.lax.dot_general, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    part = dot(x_lo, v4 * eff_h, precision=hi)
-    part += dot(x_hi - 16.0 * x_lo, h * eff_h, precision=hi)
-    part += dot(x0, u * eff_q, precision=hi)
-    part += dot(x1 - 4.0 * x0, f1 * eff_q, precision=hi)
-    part += dot(x2 - 4.0 * x1, f2 * eff_q, precision=hi)
-    part += dot(x3 - 4.0 * x2, c3 * eff_q, precision=hi)
+    part = dot(x_lo, v4 * eff_h)
+    part += dot(x_hi - 16.0 * x_lo, h * eff_h)
+    part += dot(x0, u * eff_q)
+    part += dot(x1 - 4.0 * x0, f1 * eff_q)
+    part += dot(x2 - 4.0 * x1, f2 * eff_q)
+    part += dot(x3 - 4.0 * x2, c3 * eff_q)
     part += dot(xpa[:, TK:], corr)
     _q4k_accum(o_ref, part)
 

@@ -242,22 +242,22 @@ def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret,
         # Per weight only 2 multiplies + the floor — no reconstruction, no
         # bf16 casts.  The two terms carry 16× the result's magnitude and
         # cancel, so the planes stay f32 (v·sc and h·sc are EXACT in f32:
-        # ≤8-bit int × bf16 scale needs ≤16 mantissa bits) and the dots run
-        # at precision=HIGH (bf16x3 — f32-accurate products; HIGHEST hangs
-        # Mosaic remote-compile on this libtpu).  Residual error ~16·2⁻²²
-        # per term — below the bf16 activation rounding both variants share.
-        # The rejected `vb` ablation was this with bf16 planes: 3.3% rms.
+        # ≤8-bit int × bf16 scale needs ≤16 mantissa bits) and the dots take
+        # f32 operands.  Mosaic rejects an explicit precision attr
+        # ("Unsupported dot precision: HIGH"), so accuracy rests on how its
+        # f32 dot lowers (multi-pass ⇒ fine; single-pass bf16 ⇒ the
+        # rejected `vb` ablation's 3.3% rms returns) — the chip microbench
+        # (tools/kernel_microbench.py rel_dev_vs_default) is the gate; the
+        # interpret-mode tests pin the algebra either way.
         a_v = v * sc_exp
         a_h = h * sc_exp
         x_lo = xpa[:, : TK // 2].astype(jnp.float32)
         x_hi = xpa[:, TK // 2: TK].astype(jnp.float32)
         part = jax.lax.dot_general(
             x_lo, a_v, (((1,), (1,)), ((), ())),
-            precision=jax.lax.Precision.HIGH,
             preferred_element_type=jnp.float32)
         part += jax.lax.dot_general(
             x_hi - 16.0 * x_lo, a_h, (((1,), (1,)), ((), ())),
-            precision=jax.lax.Precision.HIGH,
             preferred_element_type=jnp.float32)
         part += jax.lax.dot_general(
             xpa[:, TK:], corr, (((1,), (1,)), ((), ())),

@@ -33,7 +33,11 @@ HBM_GBPS = 819.0  # v5e HBM bandwidth (spec)
 # 8B Llama decode shapes (N, K): qkv-ish square, ffn up/gate, ffn down
 SHAPES = [(4096, 4096), (14336, 4096), (4096, 14336)]
 BATCHES = (1, 8)
-ITERS = 50
+# on-device scan steps per timed window: the window carries ~2 tunneled
+# round trips (~4 ms) of fixed dispatch+fetch overhead, so iters must be
+# large enough that overhead/iters is small vs the ~12-54 us kernels
+# (1000 -> ~4 us/iter bias, <1/3 of the smallest roofline)
+ITERS = 1000
 
 from llama_fastapi_k8s_gpu_tpu.ops.pallas.q5matmul import Q5K_VARIANTS
 from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import Q6K_VARIANTS
@@ -80,29 +84,38 @@ def make_weight(fmt: str, n: int, k: int, rng) -> dict:
 
 
 def timed_chain(linear_fn, w, b: int, k: int, n: int, iters: int) -> float:
+    """Mean per-matmul time over an ``iters``-step ON-DEVICE chain.
+
+    The chain must live inside ONE jit (``lax.scan``): a Python-level loop
+    of jit calls pays the ~2 ms tunneled dispatch round trip per step and
+    measures the tunnel, not the kernel.  The per-step coupling (output
+    folded back into the input row) is non-zero so XLA can neither hoist
+    the matmul (input changes every iteration) nor dead-code it."""
     @jax.jit
-    def step(x):
-        y = linear_fn(x, w)                       # (B, N) bf16
-        # fold the output back into the input row so the chain serializes;
-        # the coupling must be non-zero or XLA folds it and dead-codes the
-        # matmul (tiny enough that x stays ~1 over the whole chain)
-        r = jnp.sum(y, axis=1, keepdims=True).astype(jnp.bfloat16)
-        return x + r * jnp.bfloat16(1e-8)
+    def chain(x):
+        def body(x, _):
+            y = linear_fn(x, w)                   # (B, N) bf16
+            r = jnp.sum(y, axis=1, keepdims=True).astype(jnp.bfloat16)
+            return x + r * jnp.bfloat16(1e-8), ()
+
+        x, _ = jax.lax.scan(body, x, None, length=iters)
+        return x
+
+    def sync(x):
+        float(jnp.sum(x).astype(jnp.float32))     # host fetch: reliable sync
 
     x = jnp.ones((b, k), jnp.bfloat16)
-    x = step(x); x.block_until_ready()            # compile
-    x = step(x); x.block_until_ready()            # second warm (slow-start)
-    for _ in range(3):
-        x = step(x)
-    x.block_until_ready()
+    sync(chain(x))                                # compile
+    sync(chain(x))                                # second warm (slow-start)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        x = step(x)
-    x.block_until_ready()
+    sync(chain(x))
     return (time.perf_counter() - t0) / iters
 
 
 def main() -> None:
+    from llama_fastapi_k8s_gpu_tpu.utils.jaxcache import setup_compile_cache
+
+    setup_compile_cache()
     from llama_fastapi_k8s_gpu_tpu.ops.linear import linear
 
     dev = jax.devices()[0]
@@ -122,9 +135,34 @@ def main() -> None:
             w = make_weight(fmt, n, k, rng)
             # bytes / (GB/s · 1e3) = bytes/s · 1e-9 · 1e6 = microseconds
             roof_us = weight_bytes(fmt, n, k) / (HBM_GBPS * 1e3)
+            xprobe = jnp.asarray(
+                rng.standard_normal((8, k)) * 0.5, jnp.bfloat16)
+            yref = ref_var = None
             for var in VARIANTS[fmt]:
                 if fmt in KNOB:
                     os.environ[KNOB[fmt]] = var
+                # on-chip numerics cross-check vs the reference variant
+                # (named in dev_ref; normally the default) — catches
+                # toolchain-specific plane truncation (e.g. an f32 dot
+                # silently lowered to single-pass bf16) that the CPU
+                # interpret tests cannot see.  A probe failure does NOT
+                # skip timing (B=8 is one of the benchmarked sizes, but a
+                # variant may still fail one shape and serve others).
+                rel_dev = None
+                try:
+                    y = np.asarray(linear(xprobe, w), dtype=np.float32)
+                except Exception as e:
+                    rows.append({"fmt": fmt, "variant": var, "n": n, "k": k,
+                                 "probe_error": str(e)[:200]})
+                    print(f"PROBE FAIL {fmt}/{var} ({n},{k}): {str(e)[:120]}",
+                          file=sys.stderr, flush=True)
+                    y = None
+                if y is not None:
+                    if yref is None:
+                        yref, ref_var, rel_dev = y, var, 0.0
+                    else:
+                        rel_dev = float(np.abs(y - yref).max()
+                                        / (np.abs(yref).max() + 1e-9))
                 for b in BATCHES:
                     try:
                         dt = timed_chain(linear, w, b, k, n, ITERS)
@@ -140,9 +178,13 @@ def main() -> None:
                         "us": round(dt * 1e6, 1),
                         "roofline_us": round(roof_us, 1),
                         "pct_roofline": round(100 * roof_us / (dt * 1e6), 1),
+                        "rel_dev": None if rel_dev is None
+                        else round(rel_dev, 6),
+                        "dev_ref": ref_var,
                     })
                     print(f"{fmt}/{var} ({n},{k}) B={b}: "
-                          f"{dt*1e6:.1f} us ({100*roof_us/(dt*1e6):.0f}% roof)",
+                          f"{dt*1e6:.1f} us ({100*roof_us/(dt*1e6):.0f}% "
+                          f"roof, dev {rel_dev} vs {ref_var})",
                           file=sys.stderr, flush=True)
                 if fmt in KNOB:
                     del os.environ[KNOB[fmt]]
