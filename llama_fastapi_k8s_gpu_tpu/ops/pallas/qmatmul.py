@@ -247,8 +247,8 @@ def _q4k_matmul_kernel(xpa_ref, qs_ref, sm_ref, o_ref, *, interpret,
         # ("Unsupported dot precision: HIGH"), so accuracy rests on how its
         # f32 dot lowers (multi-pass ⇒ fine; single-pass bf16 ⇒ the
         # rejected `vb` ablation's 3.3% rms returns) — the chip microbench
-        # (tools/kernel_microbench.py rel_dev_vs_default) is the gate; the
-        # interpret-mode tests pin the algebra either way.
+        # (tools/kernel_microbench.py `rel_dev` / `dev_fail` rows) is the
+        # gate; the interpret-mode tests pin the algebra either way.
         a_v = v * sc_exp
         a_h = h * sc_exp
         x_lo = xpa[:, : TK // 2].astype(jnp.float32)

@@ -38,6 +38,12 @@ BATCHES = (1, 8)
 # large enough that overhead/iters is small vs the ~12-54 us kernels
 # (1000 -> ~4 us/iter bias, <1/3 of the smallest roofline)
 ITERS = 1000
+# On-chip deviation gate vs the reference variant.  Exact-math restructurings
+# sit at bf16-rounding scale (~1e-3 of max |y|); the rejected inexact `vb`
+# ablation measured 3.3e-2.  Anything past 5e-3 means a plane was silently
+# truncated (e.g. an f32 dot lowered to single-pass bf16) — the row is
+# marked dev_fail and the variant must not be selected, whatever its us.
+REL_DEV_GATE = 5e-3
 
 from llama_fastapi_k8s_gpu_tpu.ops.pallas.q5matmul import Q5K_VARIANTS
 from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import Q6K_VARIANTS
@@ -157,12 +163,18 @@ def main() -> None:
                     print(f"PROBE FAIL {fmt}/{var} ({n},{k}): {str(e)[:120]}",
                           file=sys.stderr, flush=True)
                     y = None
+                dev_fail = False
                 if y is not None:
                     if yref is None:
                         yref, ref_var, rel_dev = y, var, 0.0
                     else:
                         rel_dev = float(np.abs(y - yref).max()
                                         / (np.abs(yref).max() + 1e-9))
+                        dev_fail = rel_dev > REL_DEV_GATE
+                        if dev_fail:
+                            print(f"DEV GATE FAIL {fmt}/{var} ({n},{k}): "
+                                  f"rel_dev {rel_dev:.2e} > {REL_DEV_GATE}",
+                                  file=sys.stderr, flush=True)
                 for b in BATCHES:
                     try:
                         dt = timed_chain(linear, w, b, k, n, ITERS)
@@ -180,6 +192,7 @@ def main() -> None:
                         "pct_roofline": round(100 * roof_us / (dt * 1e6), 1),
                         "rel_dev": None if rel_dev is None
                         else round(rel_dev, 6),
+                        "dev_fail": dev_fail,
                         "dev_ref": ref_var,
                     })
                     print(f"{fmt}/{var} ({n},{k}) B={b}: "

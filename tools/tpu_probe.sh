@@ -1,0 +1,30 @@
+#!/bin/bash
+# Patient TPU tunnel probe. NEVER kills a probe attempt (a killed claimant
+# wedges the single-session tunnel — see docs/ROUND4_STATUS.md incident).
+# Each attempt runs to natural exit: success prints devices and touches
+# $OK_MARKER; failure (UNAVAILABLE after ~25 min) logs and retries.
+set -u
+LOG=${1:-/tmp/tpu_probe.log}
+OK_MARKER=/tmp/tpu_ok
+rm -f "$OK_MARKER"
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  echo "=== probe attempt $attempt start $(date +%F_%T) ===" >> "$LOG"
+  JAX_PLATFORMS=tpu python - >> "$LOG" 2>&1 <<'EOF'
+import jax
+ds = jax.devices()
+print("DEVICES:", ds)
+import jax.numpy as jnp
+x = jnp.ones((8, 8))
+print("SANITY:", float((x @ x).sum()))
+EOF
+  rc=$?
+  echo "=== probe attempt $attempt exit rc=$rc $(date +%F_%T) ===" >> "$LOG"
+  if [ $rc -eq 0 ] && grep -q "TPU\|Tpu" "$LOG"; then
+    touch "$OK_MARKER"
+    echo "TPU OK at $(date +%F_%T)" >> "$LOG"
+    exit 0
+  fi
+  sleep 30
+done
