@@ -31,7 +31,13 @@ import numpy as np
 
 from ..gguf import GGUFFile
 from ..models.config import ModelConfig
-from ..models.generate import generate_chunk_jit, init_state, prefill_jit, sample_jit
+from ..models.generate import (
+    generate_chunk_jit,
+    init_state,
+    prefill_chunk_jit,
+    prefill_jit,
+    sample_jit,
+)
 from ..models.llama import init_cache
 from ..models.params import load_params, synth_params
 from ..sampling.sample import SamplingParams, sampling_tensors, seed_window
@@ -112,6 +118,8 @@ class Engine:
         attn_impl: str = "auto",  # auto | xla | pallas (prefill flash kernel)
         spec_decode: str = "off",  # off | lookup (prompt-lookup speculation)
         spec_draft: int = 8,
+        prefix_cache: bool = True,  # reuse the previous request's KV prefix
+        prefix_min: int = 32,       # shortest common prefix worth reusing
         *,
         _parts: tuple | None = None,  # (params, cfg, tokenizer, template_kind)
     ):
@@ -230,6 +238,27 @@ class Engine:
         if not self.prefill_buckets or self.prefill_buckets[-1] < self.cfg.n_ctx:
             self.prefill_buckets.append(self.cfg.n_ctx)
         self._cache = init_cache(self.cfg)
+        # -- prompt-prefix KV reuse (serial engine only) -------------------
+        # The reference's engine re-evaluates the whole prompt every call;
+        # llama.cpp exposes prompt caching for exactly this workload (the
+        # persona + full chat history are re-sent verbatim each turn,
+        # reference api.py:44-63).  Here the serial engine remembers which
+        # token ids' KV entries are resident in its ring after each request
+        # and, when the next prompt shares that prefix, prefills only the
+        # suffix via prefill_chunk_jit — multi-turn TTFT then scales with
+        # the NEW turn's length, not the whole history.  The mesh/SP/lane
+        # engines manage caches differently and keep full prefill, and the
+        # speculative engine keeps it too: verify steps leave rejected
+        # drafts in re-claimable slots, and reuse would break spec's
+        # same-seed determinism contract (a cached and an uncached eval of
+        # the same prompt differ by bf16 KV rounding, so sampled tokens can
+        # diverge — see tests/test_spec_decode.py).
+        self._prefix_cache = (bool(prefix_cache) and type(self) is Engine
+                              and not self._spec_draft)
+        self._prefix_min = max(1, int(prefix_min))
+        #: token ids whose KV occupy ring slots [0, len) — only ever read
+        #: and written under self._lock (the single-generator invariant)
+        self._prefix_ids: list[int] = []
 
     # ------------------------------------------------------------------
     @classmethod
@@ -319,6 +348,19 @@ class Engine:
                 jnp.asarray(ids + [0], jnp.int32)[:b], jnp.int32(len(ids)), cache)
             jax.block_until_ready(logits)
             self._cache = cache
+        if self._prefix_cache:
+            # compile the suffix pass for every bucket a reuse suffix can
+            # land in (all but the largest — _prefix_reuse_len only grants
+            # reuse when the suffix bucket is strictly smaller than the
+            # prompt's), preserving the no-cold-compile-after-warmup
+            # invariant on the reuse path too.  Also drops the claim over
+            # the garbage the raw bucket loop above wrote into the ring.
+            for b in self.prefill_buckets[:-1]:
+                logits, self._cache = prefill_chunk_jit(
+                    self.params, self.cfg, jnp.zeros((b,), jnp.int32),
+                    jnp.int32(0), jnp.int32(b - 1), self._cache)
+                jax.block_until_ready(logits)
+            self._prefix_ids = []
         logger.info("warmup done in %.1fs (%d prefill buckets)",
                     time.time() - t0, len(self.prefill_buckets))
 
@@ -393,16 +435,37 @@ class Engine:
                 f"Requested tokens ({n_prompt}) exceed context window of {self.cfg.n_ctx}"
             )
         bucket = self._bucket_for(n_prompt)
-        padded = ids + [0] * (bucket - n_prompt)
         st = sampling_tensors(sp)
 
+        explicit_seed = seed is not None
         if seed is None:
             seed = self._next_seed()
         else:
             self._next_seed()  # keep the auto-seed sequence advancing
 
-        logits, cache = self._prefill_call(
-            jnp.asarray(padded, jnp.int32), jnp.int32(n_prompt), self._cache)
+        # an explicit seed is a reproducibility request: the reuse pass
+        # scores bf16-rounded cached KV where full prefill scores fresh
+        # f32 K/V, so a near-tied logit can flip — same-seed calls must
+        # instead be bit-identical, so they always take the full prefill
+        reuse = 0 if explicit_seed else \
+            self._prefix_reuse_len(ids, n_prompt, bucket)
+        # claim nothing while this request is in flight: an exception past
+        # this point must not leave a stale prefix claim over a cache whose
+        # contents are indeterminate
+        self._prefix_ids = []
+        if reuse:
+            suffix = ids[reuse:]
+            s = len(suffix)
+            sbucket = self._bucket_for(s)
+            logits, cache = prefill_chunk_jit(
+                self.params, self.cfg,
+                jnp.asarray(suffix + [0] * (sbucket - s), jnp.int32),
+                jnp.int32(reuse), jnp.int32(s - 1), self._cache)
+        else:
+            padded = ids + [0] * (bucket - n_prompt)
+            logits, cache = self._prefill_call(
+                jnp.asarray(padded, jnp.int32), jnp.int32(n_prompt),
+                self._cache)
         window, wpos = seed_window(ids)
         key = jax.random.PRNGKey(seed)
         token, window, wpos, key = sample_jit(
@@ -419,8 +482,37 @@ class Engine:
         return {
             "state": state, "st": st, "sp": sp, "n_prompt": n_prompt,
             "ids": [], "prompt_ids": ids, "first": first, "t0": t0,
-            "ttft_s": time.time() - t0,
+            "reused": reuse, "ttft_s": time.time() - t0,
         }
+
+    def _prefix_reuse_len(self, ids: list, n_prompt: int, bucket: int) -> int:
+        """Longest usable common prefix of ``ids`` vs the KV resident in the
+        ring, or 0 when reuse is off / too short / wouldn't shrink the
+        prefill bucket.  Always leaves ≥1 token to prefill (the suffix pass
+        must emit the last prompt token's logits)."""
+        if not self._prefix_cache:
+            return 0
+        prev = self._prefix_ids
+        lim = min(len(prev), n_prompt - 1)
+        i = 0
+        while i < lim and prev[i] == ids[i]:
+            i += 1
+        if i < self._prefix_min:
+            return 0
+        # The padded suffix slice [reuse, reuse + sbucket) must stay inside
+        # the KV ring: dynamic_update_slice CLAMPS an out-of-range write
+        # start, which would silently overwrite valid prefix slots with KV
+        # whose RoPE positions disagree (code-review r4 finding).  Near the
+        # context limit the reuse is therefore shortened to n_ctx - sbucket
+        # (re-prefilling a little more) rather than dropped.  Smallest
+        # bucket first: it admits the longest reuse.
+        for b in self.prefill_buckets:
+            if b >= bucket:
+                break  # suffix pads into the same program: no cycles saved
+            r = min(i, self.cfg.n_ctx - b)
+            if r >= self._prefix_min and n_prompt - r <= b:
+                return r
+        return 0
 
     def _finish(self, ctx) -> dict:
         """Return the cache buffer for reuse; finalize per-phase timings.
@@ -428,11 +520,22 @@ class Engine:
         self._cache = ctx["state"]["cache"]
         decode_s = time.time() - ctx["t0"] - ctx["ttft_s"]
         n = len(ctx["ids"])
+        if self._prefix_cache:
+            # ring slots [0, n_prompt + n - 1) now hold prompt + all
+            # generated tokens except the last sampled one (its KV write
+            # happens only when it is fed — which a finished request never
+            # does); pipelined overshoot writes land past this.  (The spec
+            # path never claims: _prefix_cache is off when _spec_draft > 0,
+            # because verify steps leave rejected drafts in re-claimable
+            # slots.)
+            keep = ctx["n_prompt"] + max(n - 1, 0)
+            self._prefix_ids = (ctx["prompt_ids"] + ctx["ids"])[:keep]
         timings = {
             "ttft_s": ctx["ttft_s"],
             "decode_s": decode_s,
             "prompt_tokens": ctx["n_prompt"],
             "completion_tokens": n,
+            "prefix_reused_tokens": ctx.get("reused", 0),
             # first token came out of prefill; the decode phase produced n-1
             "tokens_per_sec": (n - 1) / decode_s if n > 1 and decode_s > 0 else 0.0,
         }

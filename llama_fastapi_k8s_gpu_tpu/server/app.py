@@ -224,6 +224,10 @@ def create_app(engine=None, settings: Settings | None = None,
                 m.inc("spec_accepted_tokens_total", spec["accepted"])
                 m.inc("spec_verify_steps_total", spec["verify_steps"])
                 m.inc("spec_fallback_steps_total", spec["fallback_steps"])
+            reused = timings.get("prefix_reused_tokens", 0)
+            if reused:  # prompt-prefix KV reuse: prompt tokens NOT re-prefilled
+                m.inc("prefix_cache_hits_total")
+                m.inc("prefix_cache_reused_tokens_total", reused)
 
     def _answer_to_text(answer, m) -> str:
         """OpenAI-shaped dict → concatenated choice text (reference
@@ -620,6 +624,7 @@ def _default_engine_factory(settings: Settings):
             attn_impl=settings.attn_impl,
             spec_decode=settings.spec_decode,
             spec_draft=settings.spec_draft,
+            prefix_cache=settings.prefix_cache,
         )
         if settings.scheduler not in ("continuous", "cycle"):
             raise ValueError(

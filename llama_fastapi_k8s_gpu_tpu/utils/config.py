@@ -63,6 +63,11 @@ class Settings:
     attn_impl: str = "auto"         # auto | xla | pallas (prefill flash kernel)
     spec_decode: str = "off"        # off | lookup — prompt-lookup speculative
     spec_draft: int = 8             # draft tokens per verify step
+    # serial-engine prompt-prefix KV reuse (llama.cpp's prompt-cache
+    # analogue): when consecutive prompts share a token prefix — the
+    # reference workload re-sends persona + full history every turn —
+    # prefill only the suffix.  Mesh/SP/lane engines ignore it.
+    prefix_cache: bool = True
     prefill_chunk: int = 256        # continuous-scheduler admission slice size
     adm_budget: int = 512           # admission prefill tokens per scheduler
     #                                 iteration (several short admissions,
@@ -114,6 +119,7 @@ def get_settings() -> Settings:
         attn_impl=_env("LFKT_ATTN_IMPL", Settings.attn_impl),
         spec_decode=_env("LFKT_SPEC_DECODE", Settings.spec_decode),
         spec_draft=_env("LFKT_SPEC_DRAFT", Settings.spec_draft, int),
+        prefix_cache=_env("LFKT_PREFIX_CACHE", Settings.prefix_cache, bool),
         prefill_chunk=_env("LFKT_PREFILL_CHUNK", Settings.prefill_chunk, int),
         adm_budget=_env("LFKT_ADM_BUDGET", Settings.adm_budget, int),
         batch_size=_env("LFKT_BATCH_SIZE", Settings.batch_size, int),

@@ -7,11 +7,13 @@ set -u
 LOG=${1:-/tmp/tpu_probe.log}
 OK_MARKER=/tmp/tpu_ok
 rm -f "$OK_MARKER"
+: > "$LOG"
 attempt=0
 while true; do
   attempt=$((attempt + 1))
   echo "=== probe attempt $attempt start $(date +%F_%T) ===" >> "$LOG"
-  JAX_PLATFORMS=tpu python - >> "$LOG" 2>&1 <<'EOF'
+  ATT=$(mktemp)
+  JAX_PLATFORMS=tpu python - > "$ATT" 2>&1 <<'EOF'
 import jax
 ds = jax.devices()
 print("DEVICES:", ds)
@@ -20,11 +22,16 @@ x = jnp.ones((8, 8))
 print("SANITY:", float((x @ x).sum()))
 EOF
   rc=$?
+  cat "$ATT" >> "$LOG"
   echo "=== probe attempt $attempt exit rc=$rc $(date +%F_%T) ===" >> "$LOG"
-  if [ $rc -eq 0 ] && grep -q "TPU\|Tpu" "$LOG"; then
+  # judge success on THIS attempt's output only (the accumulated log may
+  # contain 'TPU' from earlier failures' error text)
+  if [ $rc -eq 0 ] && grep -q "DEVICES:.*TPU\|DEVICES:.*Tpu" "$ATT"; then
+    rm -f "$ATT"
     touch "$OK_MARKER"
     echo "TPU OK at $(date +%F_%T)" >> "$LOG"
     exit 0
   fi
+  rm -f "$ATT"
   sleep 30
 done
