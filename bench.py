@@ -245,73 +245,16 @@ def coldstart_main() -> None:
     # surface the engine's load-phase INFO logs on stderr (the suite keeps
     # per-step .err files; without this the phase attribution is silent)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
-    import numpy as np
-
     import jax
-
-    import dataclasses
-
-    from llama_fastapi_k8s_gpu_tpu.gguf import GGMLType, GGUFWriter
-    from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B
-    from llama_fastapi_k8s_gpu_tpu.testing import (
-        synth_bpe_vocab,
-        write_llama_gguf_meta,
-    )
 
     dev = jax.devices()[0]
     print(f"{_INIT_MARK} {dev}", file=sys.stderr, flush=True)
 
-    cfg = LLAMA3_8B
     path = os.environ.get("LFKT_COLDSTART_PATH", "/tmp/lfkt_coldstart_8b.gguf")
-    rng = np.random.default_rng(0)
     t0 = time.time()
     if not (os.path.exists(path)
             and os.environ.get("LFKT_COLDSTART_REUSE") == "1"):
-        tokens, merges, types = synth_bpe_vocab(n_merges=280_000)
-        # pad/trim to the exact 8B vocab so tensor shapes are authentic
-        specials = tokens[-7:]
-        body = tokens[:-7]
-        need = cfg.vocab_size - len(specials)
-        body = (body + [f"<pad{i}>" for i in range(need - len(body))])[:need]
-        tokens = body + specials
-        types = [1] * need + [3] * len(specials)
-        w = GGUFWriter(path)
-        write_llama_gguf_meta(w, dataclasses.replace(cfg, vocab_size=len(tokens)),
-                              tokens, types, merges=merges,
-                              name="llama3-8b-synthetic-q4km", n_ctx=8192)
-        kv_dim = cfg.n_kv_heads * cfg.head_dim
-
-        def raw(name, shape, kind):
-            # `shape` is numpy order (out, in); GGUF tensor shapes are
-            # innermost-first, which is what add_raw_tensor stores verbatim
-            n = int(np.prod(shape))
-            if kind == GGMLType.Q4_K:
-                data = _rand_q4k_blocks(rng, n)
-            elif kind == GGMLType.Q6_K:
-                data = _rand_q6k_blocks(rng, n)
-            else:  # F16
-                data = (rng.standard_normal(n).astype(np.float16)
-                        * cfg.dim ** -0.5).view(np.uint8)
-            w.add_raw_tensor(name, tuple(reversed(shape)), kind, data)
-
-        def f32(name, shape):
-            w.add_tensor(name, np.ones(shape, np.float32), GGMLType.F32)
-
-        raw("token_embd.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
-        for i in range(cfg.n_layers):
-            p = f"blk.{i}."
-            f32(p + "attn_norm.weight", (cfg.dim,))
-            raw(p + "attn_q.weight", (cfg.dim, cfg.dim), GGMLType.Q4_K)
-            raw(p + "attn_k.weight", (kv_dim, cfg.dim), GGMLType.Q4_K)
-            raw(p + "attn_v.weight", (kv_dim, cfg.dim), GGMLType.Q6_K)
-            raw(p + "attn_output.weight", (cfg.dim, cfg.dim), GGMLType.Q4_K)
-            f32(p + "ffn_norm.weight", (cfg.dim,))
-            raw(p + "ffn_gate.weight", (cfg.ffn_dim, cfg.dim), GGMLType.Q4_K)
-            raw(p + "ffn_up.weight", (cfg.ffn_dim, cfg.dim), GGMLType.Q4_K)
-            raw(p + "ffn_down.weight", (cfg.dim, cfg.ffn_dim), GGMLType.Q6_K)
-        f32("output_norm.weight", (cfg.dim,))
-        raw("output.weight", (cfg.vocab_size, cfg.dim), GGMLType.Q6_K)
-        w.write()
+        write_coldstart_file(path)
     write_s = time.time() - t0
     size_gb = os.path.getsize(path) / 1e9
 
@@ -348,6 +291,72 @@ def coldstart_main() -> None:
         "device": str(dev),
     }
     print(json.dumps(result), flush=True)
+
+
+def write_coldstart_file(path: str) -> None:
+    """Write the full-size 8B Q4_K_M-style GGUF coldstart_main loads.
+
+    Pure numpy — safe to run in a process that never touches the device
+    (tools/write_coldstart_gguf.py pre-writes the file so the chip-holding
+    bench only pays the LOAD, not the ~8 min write, under its watchdog)."""
+    import dataclasses
+
+    import numpy as np
+
+    from llama_fastapi_k8s_gpu_tpu.gguf import GGMLType, GGUFWriter
+    from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B
+    from llama_fastapi_k8s_gpu_tpu.testing import (
+        synth_bpe_vocab,
+        write_llama_gguf_meta,
+    )
+
+    cfg = LLAMA3_8B
+    rng = np.random.default_rng(0)
+    tokens, merges, types = synth_bpe_vocab(n_merges=280_000)
+    # pad/trim to the exact 8B vocab so tensor shapes are authentic
+    specials = tokens[-7:]
+    body = tokens[:-7]
+    need = cfg.vocab_size - len(specials)
+    body = (body + [f"<pad{i}>" for i in range(need - len(body))])[:need]
+    tokens = body + specials
+    types = [1] * need + [3] * len(specials)
+    w = GGUFWriter(path)
+    write_llama_gguf_meta(w, dataclasses.replace(cfg, vocab_size=len(tokens)),
+                          tokens, types, merges=merges,
+                          name="llama3-8b-synthetic-q4km", n_ctx=8192)
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+
+    def raw(name, shape, kind):
+        # `shape` is numpy order (out, in); GGUF tensor shapes are
+        # innermost-first, which is what add_raw_tensor stores verbatim
+        n = int(np.prod(shape))
+        if kind == GGMLType.Q4_K:
+            data = _rand_q4k_blocks(rng, n)
+        elif kind == GGMLType.Q6_K:
+            data = _rand_q6k_blocks(rng, n)
+        else:  # F16
+            data = (rng.standard_normal(n).astype(np.float16)
+                    * cfg.dim ** -0.5).view(np.uint8)
+        w.add_raw_tensor(name, tuple(reversed(shape)), kind, data)
+
+    def f32(name, shape):
+        w.add_tensor(name, np.ones(shape, np.float32), GGMLType.F32)
+
+    raw("token_embd.weight", (cfg.vocab_size, cfg.dim), GGMLType.F16)
+    for i in range(cfg.n_layers):
+        p = f"blk.{i}."
+        f32(p + "attn_norm.weight", (cfg.dim,))
+        raw(p + "attn_q.weight", (cfg.dim, cfg.dim), GGMLType.Q4_K)
+        raw(p + "attn_k.weight", (kv_dim, cfg.dim), GGMLType.Q4_K)
+        raw(p + "attn_v.weight", (kv_dim, cfg.dim), GGMLType.Q6_K)
+        raw(p + "attn_output.weight", (cfg.dim, cfg.dim), GGMLType.Q4_K)
+        f32(p + "ffn_norm.weight", (cfg.dim,))
+        raw(p + "ffn_gate.weight", (cfg.ffn_dim, cfg.dim), GGMLType.Q4_K)
+        raw(p + "ffn_up.weight", (cfg.ffn_dim, cfg.dim), GGMLType.Q4_K)
+        raw(p + "ffn_down.weight", (cfg.dim, cfg.ffn_dim), GGMLType.Q6_K)
+    f32("output_norm.weight", (cfg.dim,))
+    raw("output.weight", (cfg.vocab_size, cfg.dim), GGMLType.Q6_K)
+    w.write()
 
 
 def child_main() -> None:

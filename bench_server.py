@@ -61,6 +61,15 @@ def main() -> None:
     spec_decode = os.environ.get("LFKT_SPEC_DECODE", "off")
     spec_draft = int(os.environ.get("LFKT_SPEC_DRAFT", "8"))
     fullctx = os.environ.get("LFKT_BENCH_FULLCTX") == "1"
+    multiturn = os.environ.get("LFKT_BENCH_MULTITURN") == "1"
+    if multiturn:
+        # turn 1 is the no-reuse baseline and follow-ups are the sample;
+        # fewer than 2 turns leaves nothing to report
+        n_req = max(2, n_req)
+        if int(os.environ.get("LFKT_BENCH_BATCH", "1")) > 1:
+            raise SystemExit("LFKT_BENCH_MULTITURN measures the serial "
+                             "engine's prompt-prefix reuse; unset "
+                             "LFKT_BENCH_BATCH (lane engines keep reuse off)")
 
     if preset == "tiny":
         cfg = ModelConfig(vocab_size=0, dim=128, n_layers=2, n_heads=8,
@@ -107,11 +116,18 @@ def main() -> None:
             dp=1, batch_size=batch,
             spec_decode=spec_decode, spec_draft=spec_draft)
     else:
+        # prefix reuse stays OFF for the standard phases: they re-POST a
+        # byte-identical payload n_req times, so the serial engine's
+        # prompt-prefix KV reuse would silently shrink every measured
+        # prefill to one suffix bucket and the TTFT metric (same name as
+        # prior rounds') would stop measuring full-stack prefill latency.
+        # The multiturn mode measures the reuse path, explicitly labeled.
         eng = Engine.from_parts(params, cfg, tok, template_kind="llama3",
                                 max_gen_tokens=max_tokens,
                                 attn_impl=cfg.attn_impl,
                                 spec_decode=spec_decode,
-                                spec_draft=spec_draft)
+                                spec_draft=spec_draft,
+                                prefix_cache=multiturn)
     # compile every shape BEFORE the server phase, exactly like the
     # production factory (server/app.py calls eng.warmup() at startup);
     # without it the first request compiles for ~60 s and the 25 s
@@ -179,45 +195,6 @@ def main() -> None:
         r.read()
     warm_s = time.time() - t_start
 
-    lat = []
-    for _ in range(n_req):
-        t0 = time.perf_counter()
-        with urllib.request.urlopen(post("/response"), timeout=600) as r:
-            json.loads(r.read())
-        lat.append((time.perf_counter() - t0) * 1e3)
-
-    ttft = []
-    for _ in range(n_req):
-        t0 = time.perf_counter()
-        first = None
-        # drain the stream fully: the serial Engine runs an abandoned
-        # generation to completion, which would otherwise queue under —
-        # and inflate — the NEXT sample's TTFT
-        with urllib.request.urlopen(post("/response/stream"), timeout=600) as r:
-            for raw in r:
-                line = raw.decode("utf-8", "replace").strip()
-                if not line.startswith("data:"):
-                    continue
-                body = line[5:].strip()
-                if body == "[DONE]":
-                    break
-                delta = json.loads(body)["choices"][0]["delta"]
-                if first is None and delta.get("content"):
-                    first = (time.perf_counter() - t0) * 1e3
-        ttft.append(first if first is not None
-                    else (time.perf_counter() - t0) * 1e3)
-
-    # concurrent load (BASELINE config #5: "concurrent /response load ...
-    # back-pressure"): fan out parallel POSTs; the server queues up to 5 and
-    # 503s beyond (reference api.py:113,158-160 semantics preserved).
-    # Service capacity = inflight(batch) + queue(5), so the default
-    # concurrency must exceed batch + 5 for the 503 path to actually fire.
-    conc = int(os.environ.get("LFKT_BENCH_CONCURRENCY",
-                              str(max(8, batch + 8))))
-    per = max(2, n_req // 2)
-    oks, rejects, errors = [], [], []
-    lock = threading.Lock()
-
     def read_metrics_counters(names) -> dict | None:
         """Scrape named counters off the app's /metrics; None when the
         endpoint is unreadable (so callers report null, not fabricated
@@ -233,6 +210,116 @@ def main() -> None:
             if len(parts) == 2 and parts[0] in out:
                 out[parts[0]] = float(parts[1])
         return out
+
+    def stream_ttft(body: bytes):
+        """POST /response/stream; returns (ttft_ms, full_text).  Drains the
+        stream fully (an abandoned generation runs to completion and would
+        queue under the next sample's TTFT)."""
+        req = urllib.request.Request(
+            base + "/response/stream", data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        first = None
+        parts: list[str] = []
+        with urllib.request.urlopen(req, timeout=600) as r:
+            for raw in r:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                body_ln = line[5:].strip()
+                if body_ln == "[DONE]":
+                    break
+                delta = json.loads(body_ln)["choices"][0]["delta"]
+                c = delta.get("content")
+                if c:
+                    if first is None:
+                        first = (time.perf_counter() - t0) * 1e3
+                    parts.append(c)
+        if first is None:
+            first = (time.perf_counter() - t0) * 1e3
+        return first, "".join(parts)
+
+    if multiturn:
+        # LFKT_BENCH_MULTITURN=1: ONE growing conversation — each request
+        # re-sends persona + full history + a new user turn, the reference's
+        # actual workload shape (api.py:44-63).  Follow-up turns share their
+        # whole history prefix with the previous request, so this measures
+        # what the serial engine's prompt-prefix KV reuse is for: follow-up
+        # TTFT scaling with the NEW turn, not the history.  Serial-engine
+        # semantics (one conversation), so the concurrency phase is skipped.
+        followups = [
+            "Interesting, tell me more.", "Why is that?", "Go on.",
+            "What happened next?", "Could you expand on that?",
+            "How does that relate?", "Give me an example.",
+        ]
+        convo = [{"turn": "user",
+                  "message": "Hello! Please introduce yourself briefly."}]
+
+        def mt_payload() -> bytes:
+            return json.dumps({
+                "bot_profile": {
+                    "name": "Ada",
+                    "appearance": "tall, green eyes, red hair, calm voice",
+                    "system_prompt": "You are a concise assistant.",
+                },
+                "user_profile": {"name": "Sam"},
+                "context": convo,
+            }).encode()
+
+        first_ttft = None
+        follow = []
+        for k in range(n_req):
+            ms, text = stream_ttft(mt_payload())
+            if k == 0:
+                first_ttft = ms
+            else:
+                follow.append(ms)
+            convo.append({"turn": "bot", "message": (text or "...")[:400]})
+            convo.append({"turn": "user",
+                          "message": followups[k % len(followups)]})
+        counters = read_metrics_counters(
+            ("prefix_cache_hits_total", "prefix_cache_reused_tokens_total"))
+        follow.sort()
+        pq = lambda v, q: v[min(len(v) - 1, int(q * len(v)))]  # noqa: E731
+        result = {
+            "metric": (f"server_ttft_ms_p50[/response,{preset},{wfmt}"
+                       ",multiturn]"),
+            "value": round(pq(follow, 0.5), 1),
+            "unit": "ms",
+            "vs_baseline": round(A10G_TTFT_MS / max(pq(follow, 0.5), 1e-9), 3),
+            "ttft_ms_p95_server": round(pq(follow, 0.95), 1),
+            "turn1_ttft_ms": round(first_ttft, 1),
+            "turns": n_req,
+            "max_tokens": max_tokens,
+            "warmup_s": round(warm_s, 1),
+            "prefix_cache": counters,
+            "device": str(dev),
+        }
+        print(json.dumps(result), flush=True)
+        return
+
+    lat = []
+    for _ in range(n_req):
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(post("/response"), timeout=600) as r:
+            json.loads(r.read())
+        lat.append((time.perf_counter() - t0) * 1e3)
+
+    ttft = []
+    for _ in range(n_req):
+        ms, _text = stream_ttft(payload)
+        ttft.append(ms)
+
+    # concurrent load (BASELINE config #5: "concurrent /response load ...
+    # back-pressure"): fan out parallel POSTs; the server queues up to 5 and
+    # 503s beyond (reference api.py:113,158-160 semantics preserved).
+    # Service capacity = inflight(batch) + queue(5), so the default
+    # concurrency must exceed batch + 5 for the 503 path to actually fire.
+    conc = int(os.environ.get("LFKT_BENCH_CONCURRENCY",
+                              str(max(8, batch + 8))))
+    per = max(2, n_req // 2)
+    oks, rejects, errors = [], [], []
+    lock = threading.Lock()
 
     def read_generated_total() -> float | None:
         # server-side counter of usage.completion_tokens per completed

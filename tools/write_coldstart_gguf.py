@@ -1,0 +1,24 @@
+"""Pre-write the coldstart bench's 5.9 GB synthetic Q4_K_M GGUF.
+
+Run this BEFORE the chip suite: it is pure numpy (never initializes a JAX
+backend, so it cannot contend for the single-session device tunnel), and it
+moves the ~8 min file write out of the device-holding bench process — the
+round-4 coldstart watchdog kill happened because write+load together
+overran LFKT_BENCH_TOTAL_TIMEOUT.  The bench then runs with
+LFKT_COLDSTART_REUSE=1 and pays only the load it is meant to measure.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import write_coldstart_file  # noqa: E402
+
+if __name__ == "__main__":
+    path = os.environ.get("LFKT_COLDSTART_PATH", "/tmp/lfkt_coldstart_8b.gguf")
+    t0 = time.time()
+    write_coldstart_file(path)
+    print(f"{path}: {os.path.getsize(path) / 1e9:.2f} GB "
+          f"in {time.time() - t0:.1f}s", flush=True)
