@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-4 remediation chip suite. Run ALONE (single-session device tunnel),
+# AFTER tools/run_chip_suite.sh has fully exited.
+#
+# Differences from run_chip_suite.sh, each from a round-4 incident:
+#   - probe gate: one patient kill-free probe (tools/tpu_probe.sh) must
+#     succeed before any bench child is spawned, so a wedged tunnel never
+#     meets a watchdog that kills children into the claim queue;
+#   - persistent XLA compile cache for every step (the first suite paid
+#     full remote compiles 9 times);
+#   - coldstart reuses the pre-written file (tools/write_coldstart_gguf.py)
+#     and gets a raised total timeout: write+load in one child overran the
+#     default 1500 s once host CPU was contended, and the watchdog kill
+#     wedged the tunnel for ~an hour;
+#   - adds the kernel-variant microbench and the multiturn prefix-cache
+#     bench, which the first suite predates.
+# Steps already measured successfully today are NOT repeated.
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%F)
+OUT=docs/bench
+mkdir -p "$OUT"
+export LFKT_COMPILE_CACHE_DIR=${LFKT_COMPILE_CACHE_DIR:-/tmp/lfkt_xla_cache}
+
+if pgrep -f "run_chip_suite.sh" | grep -v $$ | grep -qv pgrep; then
+  echo "refusing to start: run_chip_suite.sh still running" >&2
+  exit 1
+fi
+
+echo "=== probe gate ($(date +%T)) ===" >&2
+bash tools/tpu_probe.sh /tmp/tpu_probe_suite2.log
+echo "=== probe ok ($(date +%T)) ===" >&2
+sleep 10   # let the probe's claim fully release
+
+step() {
+  local name="$1"; shift
+  echo "=== $name ($(date +%T)) ===" >&2
+  "$@" > "$OUT/_tmp.$name.json" 2> "$OUT/_tmp.$name.err"
+  local rc=$?
+  tail -1 "$OUT/_tmp.$name.json" > "$OUT/${name}_${TS}.json"
+  echo "rc=$rc $(head -c 200 "$OUT/${name}_${TS}.json")" >&2
+  sleep 10
+}
+
+# 1) kernel-variant microbench (the round's biggest open perf lever)
+step kernel_microbench python tools/kernel_microbench.py
+# 2) cold start: pre-written file, load only, generous ceiling
+python tools/write_coldstart_gguf.py >&2 || true   # no-op if file exists
+step coldstart env LFKT_BENCH_COLDSTART=1 LFKT_COLDSTART_REUSE=1 \
+  LFKT_BENCH_TOTAL_TIMEOUT=2700 python bench.py
+# 3) server TTFT, short + full-context bucket
+step bench_server_short python bench_server.py
+step bench_server_fullctx env LFKT_BENCH_FULLCTX=1 python bench_server.py
+# 4) multiturn conversation: prompt-prefix KV reuse through the stack
+step bench_server_multiturn env LFKT_BENCH_MULTITURN=1 python bench_server.py
+# 5) 8-lane aggregate with budgeted multi-admission (+ spec variant)
+step bench_server_batch8 env LFKT_BENCH_BATCH=8 python bench_server.py
+step bench_server_batch8_spec env LFKT_BENCH_BATCH=8 LFKT_SPEC_DECODE=lookup \
+  python bench_server.py
+# 6) 8k long-context preset
+step bench_8k env LFKT_BENCH_PRESET=llama3-8b-8k python bench.py
+echo "=== suite2 done ($(date +%T)) ===" >&2

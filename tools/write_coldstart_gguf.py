@@ -18,6 +18,10 @@ from bench import write_coldstart_file  # noqa: E402
 
 if __name__ == "__main__":
     path = os.environ.get("LFKT_COLDSTART_PATH", "/tmp/lfkt_coldstart_8b.gguf")
+    if os.path.exists(path) and os.environ.get("LFKT_COLDSTART_REWRITE") != "1":
+        print(f"{path}: exists ({os.path.getsize(path) / 1e9:.2f} GB); "
+              f"set LFKT_COLDSTART_REWRITE=1 to regenerate", flush=True)
+        raise SystemExit(0)
     t0 = time.time()
     write_coldstart_file(path)
     print(f"{path}: {os.path.getsize(path) / 1e9:.2f} GB "
