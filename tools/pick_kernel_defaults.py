@@ -1,0 +1,48 @@
+"""Pick per-format fused-kernel default variants from a microbench artifact.
+
+Reads a tools/kernel_microbench.py JSON artifact and prints, per format, the
+variant with the best geomean time over the 8B decode shapes at B=1 —
+excluding any variant with a dev_fail row (on-chip numerics gate) or an
+error/probe_error row on any shape.  The printed winner is what the
+Q*_VARIANTS tuple's first element (the env-knob default) should be.
+
+Usage: python tools/pick_kernel_defaults.py docs/bench/kernel_microbench_*.json
+"""
+
+import json
+import math
+import sys
+
+
+def main(path: str) -> None:
+    data = json.load(open(path))
+    rows = data["rows"]
+    by = {}
+    bad = set()
+    for r in rows:
+        key = (r["fmt"], r.get("variant"))
+        if r.get("dev_fail") or "error" in r or "probe_error" in r:
+            bad.add(key)
+            continue
+        if r.get("b") == 1 and "us" in r:
+            by.setdefault(key, []).append(r["us"])
+    fmts = sorted({f for f, _ in list(by) + list(bad)})
+    for fmt in fmts:
+        cands = []
+        for (f, var), times in by.items():
+            if f != fmt:
+                continue
+            tag = " DEV-FAIL/ERROR" if (f, var) in bad else ""
+            gm = math.exp(sum(math.log(t) for t in times) / len(times))
+            cands.append((gm, var, tag))
+        cands.sort()
+        print(f"{fmt}:")
+        for gm, var, tag in cands:
+            print(f"  {var:10s} geomean {gm:7.1f} us{tag}")
+        ok = [c for c in cands if not c[2]]
+        if ok:
+            print(f"  -> default: {ok[0][1]}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
