@@ -2,9 +2,12 @@
 # Patient TPU tunnel probe. NEVER kills a probe attempt (a killed claimant
 # wedges the single-session tunnel — see docs/ROUND4_STATUS.md incident).
 # Each attempt runs to natural exit: success prints devices and touches
-# $OK_MARKER; failure (UNAVAILABLE after ~25 min) logs and retries.
+# $OK_MARKER; failure (UNAVAILABLE after ~25 min) logs, sleeps
+# $PROBE_SLEEP s (default 30; set ~2700 for a mostly-quiet posture when a
+# wedged claim may need idle time to clear), and retries.
 set -u
 LOG=${1:-/tmp/tpu_probe.log}
+PROBE_SLEEP=${PROBE_SLEEP:-30}
 OK_MARKER=/tmp/tpu_ok
 rm -f "$OK_MARKER"
 : > "$LOG"
@@ -33,5 +36,5 @@ EOF
     exit 0
   fi
   rm -f "$ATT"
-  sleep 30
+  sleep "$PROBE_SLEEP"
 done
