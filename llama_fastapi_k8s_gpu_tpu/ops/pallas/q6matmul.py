@@ -65,7 +65,13 @@ from .qmatmul import (
     stacked_partitioned,
 )
 
-Q6K_VARIANTS = ("cur", "parfloor", "vbf32")
+# first entry = the env-knob default (ops/pallas/qmatmul.py::_env_variant).
+# parfloor leads: bit-identical to `cur` (independent exact f32 floors vs
+# the serial remainder chain) and the only engine-level chip A/B of the
+# variants measured it ahead on the q4km grid — 72.32 tok/s with
+# LFKT_Q6K_KERNEL=parfloor vs 71.78/71.59 without
+# (docs/bench/bench_q4km_{resplit_parfloor,cur,resplit}_2026-07-31.json).
+Q6K_VARIANTS = ("parfloor", "cur", "vbf32")
 
 _SUBS6 = TK // 16    # 128 sub-blocks of 16 per k-tile
 TKA6 = TK + 256      # + [xsum_all(128) | xsum_hi(128)] correction columns

@@ -187,8 +187,10 @@ def test_parfloor_variant_bit_identical(monkeypatch):
     wd = prep_q6k(quant_q6_k(w.reshape(-1)), n, k)
     x = jnp.asarray(rng.standard_normal((4, k)), jnp.bfloat16)
     # the variant is part of the builder cache key, so flipping the env
-    # between calls re-traces without any cache_clear choreography
-    monkeypatch.delenv("LFKT_Q6K_KERNEL", raising=False)
+    # between calls re-traces without any cache_clear choreography.
+    # Compare cur vs parfloor EXPLICITLY (parfloor is now the tuple
+    # default, so an unset env would compare parfloor with itself).
+    monkeypatch.setenv("LFKT_Q6K_KERNEL", "cur")
     a = np.asarray(q6k_matmul(x, wd, interpret=True))
     monkeypatch.setenv("LFKT_Q6K_KERNEL", "parfloor")
     b = np.asarray(q6k_matmul(x, wd, interpret=True))
