@@ -644,7 +644,8 @@ def _default_engine_factory(settings: Settings):
                     settings.model_path, tp=settings.mesh_tp,
                     batch_size=settings.batch_size,
                     prefill_chunk=settings.prefill_chunk,
-                    adm_budget=settings.adm_budget, **kw)
+                    adm_budget=settings.adm_budget,
+                    lane_prefix_cache=settings.lane_prefix_cache, **kw)
             else:
                 eng = MeshEngine(settings.model_path, tp=settings.mesh_tp,
                                  batch_size=settings.batch_size, **kw)

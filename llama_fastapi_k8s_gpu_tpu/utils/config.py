@@ -68,6 +68,12 @@ class Settings:
     # reference workload re-sends persona + full history every turn —
     # prefill only the suffix.  Mesh/SP/lane engines ignore it.
     prefix_cache: bool = True
+    # the continuous scheduler's analogue: admissions whose prompt shares
+    # a freed lane's conversation history snapshot that lane's KV and
+    # prefill only the suffix slices (chunk-aligned).  Off by default —
+    # the admission path is the scheduler's measured bottleneck, so flip
+    # this knob deliberately per deployment.
+    lane_prefix_cache: bool = False
     prefill_chunk: int = 256        # continuous-scheduler admission slice size
     adm_budget: int = 512           # admission prefill tokens per scheduler
     #                                 iteration (several short admissions,
@@ -120,6 +126,8 @@ def get_settings() -> Settings:
         spec_decode=_env("LFKT_SPEC_DECODE", Settings.spec_decode),
         spec_draft=_env("LFKT_SPEC_DRAFT", Settings.spec_draft, int),
         prefix_cache=_env("LFKT_PREFIX_CACHE", Settings.prefix_cache, bool),
+        lane_prefix_cache=_env("LFKT_LANE_PREFIX_CACHE",
+                               Settings.lane_prefix_cache, bool),
         prefill_chunk=_env("LFKT_PREFILL_CHUNK", Settings.prefill_chunk, int),
         adm_budget=_env("LFKT_ADM_BUDGET", Settings.adm_budget, int),
         batch_size=_env("LFKT_BATCH_SIZE", Settings.batch_size, int),

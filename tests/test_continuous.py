@@ -469,3 +469,118 @@ def test_outputs_independent_of_adm_budget(tmp_path):
             eng.shutdown()
 
     assert run(1) == run(512)
+
+
+# ---------------------------------------------------------------------------
+# lane-prefix KV reuse (LFKT_LANE_PREFIX_CACHE): a freed lane's finished
+# conversation serves as the KV prefix for the next same-conversation
+# admission — the scheduler's analogue of the serial engine's prompt cache
+# ---------------------------------------------------------------------------
+
+LP_SYS = ("You are a meticulous assistant who answers carefully. " * 4).strip()
+
+
+def _lp_multiturn(reply=None, new="And another one please."):
+    msgs = [
+        {"role": "system", "content": LP_SYS},
+        {"role": "user", "content": "Tell me something interesting please."},
+    ]
+    if reply is not None:
+        msgs += [{"role": "assistant", "content": reply},
+                 {"role": "user", "content": new}]
+    return msgs
+
+
+@pytest.fixture(scope="module")
+def lp_engine(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("model") / "tiny-lp.gguf")
+    write_tiny_llama_gguf(path)
+    eng = ContinuousEngine(path, dp=1, tp=1, batch_size=2, n_ctx=512,
+                           decode_chunk=4, max_gen_tokens=16,
+                           prefill_chunk=16, lane_prefix_cache=True,
+                           prefill_buckets=(64, 128, 256, 512))
+    yield eng
+    eng.shutdown()
+
+
+def test_lane_prefix_reuse_fires_on_multiturn(lp_engine):
+    t1 = lp_engine.create_chat_completion(_lp_multiturn(), temperature=0.0,
+                                          max_tokens=8)
+    assert t1["lfkt_timings"]["prefix_reused_tokens"] == 0
+    reply = t1["choices"][0]["message"]["content"]
+    t2 = lp_engine.create_chat_completion(_lp_multiturn(reply),
+                                          temperature=0.0, max_tokens=8)
+    reused = t2["lfkt_timings"]["prefix_reused_tokens"]
+    assert reused >= lp_engine._prefill_chunk
+    assert reused % lp_engine._prefill_chunk == 0      # chunk-aligned
+    assert reused < t2["usage"]["prompt_tokens"]
+    stats = lp_engine.scheduler_stats()
+    assert stats["lane_prefix_hits"] >= 1
+    assert stats["lane_prefix_reused_tokens"] >= reused
+    assert t2["choices"][0]["message"]["content"]
+
+
+def test_lane_prefix_repeated_reuse_stays_well_formed(lp_engine):
+    """Back-to-back identical follow-ups keep reusing lane claims and keep
+    producing complete responses.  (Cross-request token equality is NOT
+    asserted: each request may reuse a different lane's claim — e.g. the
+    previous request's own, which matches deeper — so the reused-KV
+    prefixes differ by bf16 rounding and a near-tied greedy argmax can
+    legitimately flip; the serial engine's tests pin reuse numerics.)"""
+    t1 = lp_engine.create_chat_completion(_lp_multiturn(), temperature=0.0,
+                                          max_tokens=8)
+    reply = t1["choices"][0]["message"]["content"]
+    for _ in range(3):
+        out = lp_engine.create_chat_completion(_lp_multiturn(reply),
+                                               temperature=0.0, max_tokens=8)
+        assert out["lfkt_timings"]["prefix_reused_tokens"] >= \
+            lp_engine._prefill_chunk
+        assert out["choices"][0]["message"]["content"]
+        assert out["usage"]["completion_tokens"] >= 1
+
+
+def test_lane_prefix_explicit_seed_bypasses(lp_engine):
+    t1 = lp_engine.create_chat_completion(_lp_multiturn(), temperature=0.0,
+                                          max_tokens=8)
+    reply = t1["choices"][0]["message"]["content"]
+    t2 = lp_engine.create_chat_completion(_lp_multiturn(reply),
+                                          temperature=0.0, max_tokens=8,
+                                          seed=5)
+    assert t2["lfkt_timings"]["prefix_reused_tokens"] == 0
+
+
+def test_lane_prefix_divergent_prompt_no_reuse(lp_engine):
+    lp_engine.create_chat_completion(_lp_multiturn(), temperature=0.0,
+                                     max_tokens=8)
+    other = [{"role": "system", "content": "Terse pirate bot speaks here."},
+             {"role": "user", "content": "List three fruits right now."}]
+    got = lp_engine.create_chat_completion(other, temperature=0.0,
+                                           max_tokens=8)
+    assert got["lfkt_timings"]["prefix_reused_tokens"] == 0
+    assert got["choices"][0]["message"]["content"]
+
+
+def test_lane_prefix_claim_bookkeeping_unit(lp_engine):
+    """White-box: claim recording caps at the residency invariant and
+    reuse lookup is chunk-aligned with the last-token guard."""
+    import types
+
+    chunk = lp_engine._prefill_chunk
+    slot = types.SimpleNamespace(n_prompt=40, gens=[7, 8, 9],
+                                 ids=list(range(40)))
+    saved = list(lp_engine._lane_claims)
+    try:
+        lp_engine._free_lane(0, slot, [None, None])
+        claim = lp_engine._lane_claims[0]
+        # slots [0, 40+3-1): prompt + all gens except the last sampled one
+        assert claim == list(range(40)) + [7, 8]
+        # identical prompt: reuse rounds down to a chunk multiple and
+        # never consumes the last real token
+        ids = claim + [99] * 30
+        reuse, src = lp_engine._find_lane_reuse(ids, len(ids))
+        assert src == 0 and reuse == (len(claim) // chunk) * chunk
+        # too-short share → no reuse
+        reuse, src = lp_engine._find_lane_reuse([1] * 64, 64)
+        assert reuse == 0 and src is None
+    finally:
+        lp_engine._lane_claims[:] = saved
