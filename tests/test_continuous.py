@@ -584,3 +584,24 @@ def test_lane_prefix_claim_bookkeeping_unit(lp_engine):
         assert reuse == 0 and src is None
     finally:
         lp_engine._lane_claims[:] = saved
+
+
+def test_lane_prefix_reuse_on_sharded_mesh(tmp_path):
+    """The lane→scratch snapshot gather must compose with GSPMD when the
+    batched cache is dp-sharded (the v5e-4 serving config)."""
+    path = str(tmp_path / "tiny-lp-mesh.gguf")
+    write_tiny_llama_gguf(path)
+    eng = ContinuousEngine(path, dp=2, tp=2, batch_size=4, n_ctx=512,
+                           decode_chunk=4, max_gen_tokens=12,
+                           prefill_chunk=16, lane_prefix_cache=True,
+                           prefill_buckets=(64, 128, 256, 512))
+    try:
+        t1 = eng.create_chat_completion(_lp_multiturn(), temperature=0.0,
+                                        max_tokens=8)
+        reply = t1["choices"][0]["message"]["content"]
+        t2 = eng.create_chat_completion(_lp_multiturn(reply),
+                                        temperature=0.0, max_tokens=8)
+        assert t2["lfkt_timings"]["prefix_reused_tokens"] >= 16
+        assert t2["choices"][0]["message"]["content"]
+    finally:
+        eng.shutdown()
