@@ -1,0 +1,114 @@
+#!/bin/bash
+# Round-5 chip suite. Run ALONE (single-session device tunnel) — probe-gated
+# per the round-4 incident playbook (docs/ROUND4_STATUS.md): one patient
+# kill-free probe must succeed before any bench child spawns, benches exit on
+# their own timeouts, no heavy host-CPU work may run concurrently.
+#
+# Order banks the round's evidence most-valuable-first (VERDICT r4 #1):
+#   1. headline q4km bench with CURRENT defaults — a nonzero ≥72 tok/s
+#      artifact exists the moment step 1 lands, whatever happens later;
+#   2. kernel-variant microbench (vbf32/onedot/resplit — the ~1.5-2x
+#      roofline lever, VERDICT r4 #2);
+#   3. if the picker finds a dev-gate-passing winner that differs from the
+#      shipped default, an engine-level A/B headline run under env knobs
+#      (no code change; the code flip is a separate reviewed commit);
+#   4. coldstart (pre-written file, VERDICT r4 #3) — server TTFT
+#      short+fullctx (#6) — multiturn (#8 evidence) — 8-lane aggregate
+#      plain/+lane-prefix/+spec (#7, #8) — Mistral 1k + 8k sliding-window
+#      (#4) — Llama-8k control.
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%F)
+OUT=docs/bench
+mkdir -p "$OUT"
+export LFKT_COMPILE_CACHE_DIR=${LFKT_COMPILE_CACHE_DIR:-/tmp/lfkt_xla_cache}
+# fewer, longer watchdog windows: a kill mid-claim wedges the tunnel
+export LFKT_BENCH_TOTAL_TIMEOUT=${LFKT_BENCH_TOTAL_TIMEOUT:-2700}
+
+if pgrep -f "run_chip_suite[.2]" | grep -v $$ | grep -qv pgrep; then
+  echo "refusing to start: an earlier chip suite is still running" >&2
+  exit 1
+fi
+
+echo "=== probe gate ($(date +%T)) ===" >&2
+bash tools/tpu_probe.sh /tmp/tpu_probe_suite3.log
+echo "=== probe ok ($(date +%T)) ===" >&2
+sleep 10   # let the probe's claim fully release
+
+step() {
+  local name="$1"; shift
+  echo "=== $name ($(date +%T)) ===" >&2
+  "$@" > "$OUT/_tmp.$name.json" 2> "$OUT/_tmp.$name.err"
+  local rc=$?
+  tail -1 "$OUT/_tmp.$name.json" > "$OUT/${name}_${TS}.json"
+  echo "rc=$rc $(head -c 200 "$OUT/${name}_${TS}.json")" >&2
+  sleep 10
+}
+
+# 1) bank the headline FIRST (current defaults)
+step bench_q4km_headline python bench.py
+
+# 2) kernel-variant microbench: every Q*_VARIANTS entry vs roofline + the
+#    on-chip numerics gate (dev_fail rows are never selectable)
+step kernel_microbench python tools/kernel_microbench.py
+
+# 3) engine-level A/B iff a gate-passing variant beats the shipped default
+python - "$OUT/kernel_microbench_${TS}.json" > /tmp/lfkt_kernel_env.sh <<'EOF'
+import json, math, sys
+DEFAULTS = {"q4k": "cur", "q5k": "cur", "q6k": "parfloor"}
+KNOB = {"q4k": "LFKT_Q4K_KERNEL", "q5k": "LFKT_Q5K_KERNEL",
+        "q6k": "LFKT_Q6K_KERNEL"}
+try:
+    rows = json.load(open(sys.argv[1]))["rows"]
+except Exception as e:
+    print(f"# picker: unreadable artifact ({e})")
+    raise SystemExit
+by, bad = {}, set()
+for r in rows:
+    key = (r["fmt"], r.get("variant"))
+    if r.get("dev_fail") or "error" in r or "probe_error" in r:
+        bad.add(key)
+    elif r.get("b") == 1 and "us" in r:
+        by.setdefault(key, []).append(r["us"])
+for fmt, default in DEFAULTS.items():
+    cands = sorted(
+        (math.exp(sum(map(math.log, ts)) / len(ts)), var)
+        for (f, var), ts in by.items() if f == fmt and (f, var) not in bad)
+    if cands and cands[0][1] != default:
+        print(f"export {KNOB[fmt]}={cands[0][1]}"
+              f"  # geomean {cands[0][0]:.1f} us vs default")
+EOF
+cat /tmp/lfkt_kernel_env.sh >&2
+if grep -q '^export' /tmp/lfkt_kernel_env.sh; then
+  ( . /tmp/lfkt_kernel_env.sh
+    step bench_q4km_variant_ab python bench.py )
+fi
+
+# 4) cold start: pre-written file, load only, generous ceiling
+python tools/write_coldstart_gguf.py >&2 || true   # no-op if file exists
+step coldstart env LFKT_BENCH_COLDSTART=1 LFKT_COLDSTART_REUSE=1 python bench.py
+
+# 5) server TTFT, short + full-context (1024-token bucket, VERDICT r4 #6)
+step bench_server_short python bench_server.py
+step bench_server_fullctx env LFKT_BENCH_FULLCTX=1 python bench_server.py
+
+# 6) multiturn conversation: prompt-prefix KV reuse through the stack
+step bench_server_multiturn env LFKT_BENCH_MULTITURN=1 python bench_server.py
+
+# 7) 8-lane aggregate: plain / +lane-prefix reuse / +spec decode
+step bench_server_batch8 env LFKT_BENCH_BATCH=8 python bench_server.py
+step bench_server_batch8_prefix env LFKT_BENCH_BATCH=8 \
+  LFKT_LANE_PREFIX_CACHE=1 python bench_server.py
+step bench_server_batch8_spec env LFKT_BENCH_BATCH=8 LFKT_SPEC_DECODE=lookup \
+  python bench_server.py
+
+# 8) Mistral-7B (BASELINE config #4): reference operating point + the 8k
+#    run where the sliding-window block-skip actually truncates attention
+step bench_mistral env LFKT_BENCH_PRESET=mistral-7b python bench.py
+step bench_mistral_8k env LFKT_BENCH_PRESET=mistral-7b LFKT_BENCH_NCTX=8192 \
+  LFKT_BENCH_PROMPT=4096 python bench.py
+
+# 9) Llama 8k long-context control
+step bench_8k env LFKT_BENCH_PRESET=llama3-8b-8k python bench.py
+
+echo "=== suite3 done ($(date +%T)) ===" >&2
