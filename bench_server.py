@@ -268,8 +268,28 @@ def main() -> None:
 
         first_ttft = None
         follow = []
+        # Per-turn reused-token deltas: once the server's reference-parity
+        # truncation starts popping the oldest history turn (api.py:54-65),
+        # follow-ups stop sharing the resident prefix and silently measure
+        # full prefill again.  Reporting reuse PER TURN makes those turns
+        # distinguishable in the artifact instead of polluting an
+        # aggregate labeled "multiturn reuse" (ADVICE r4 #3).
+        per_turn = []
+
+        def reused_total() -> float | None:
+            got = read_metrics_counters(("prefix_cache_reused_tokens_total",))
+            return None if got is None else got["prefix_cache_reused_tokens_total"]
+
         for k in range(n_req):
+            r_before = reused_total()
             ms, text = stream_ttft(mt_payload())
+            r_after = reused_total()
+            per_turn.append({
+                "turn": k + 1, "ttft_ms": round(ms, 1),
+                "reused_tokens": (int(r_after - r_before)
+                                  if r_after is not None and r_before is not None
+                                  else None),
+            })
             if k == 0:
                 first_ttft = ms
             else:
@@ -293,6 +313,7 @@ def main() -> None:
             "max_tokens": max_tokens,
             "warmup_s": round(warm_s, 1),
             "prefix_cache": counters,
+            "per_turn": per_turn,
             "device": str(dev),
         }
         print(json.dumps(result), flush=True)

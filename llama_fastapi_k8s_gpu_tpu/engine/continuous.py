@@ -362,11 +362,15 @@ class ContinuousEngine(MeshEngine):
     # chunked-prefill, TPU-static-shape edition — slice shapes come from
     # the fixed bucket set, so the compiled-program set stays closed).
 
-    def _free_lane(self, lane: int, slot: _Slot, slots: list) -> None:
+    def _free_lane(self, lane: int, slot: _Slot, slots: list,
+                   claim: bool = True) -> None:
         """Release ``slot``'s lane (no-op if it never occupied one) and
         record which token ids' KV remain valid there for lane-prefix
         reuse.  The ONE place the free-lane invariant lives — every path
-        that finishes a slot must come through here.
+        that finishes a slot must come through here.  ``claim=False`` for
+        error finishes (a device fault surfaced at fetch means the KV that
+        prefill left in the lane is of unknown validity — it must not seed
+        a later admission's reuse).
 
         Claim residency matches the serial engine's prefix cache
         (engine.py::_finish): ring slots [0, n_prompt + len(gens) - 1)
@@ -376,6 +380,9 @@ class ContinuousEngine(MeshEngine):
         if slots[lane] is slot:
             slots[lane] = None
         if not self._lane_prefix:
+            return
+        if not claim:
+            self._lane_claims[lane] = None
             return
         keep = min(slot.n_prompt + max(len(slot.gens) - 1, 0),
                    self.cfg.n_ctx - 1)
@@ -437,8 +444,8 @@ class ContinuousEngine(MeshEngine):
                 # any later decode writes, so the claim region is stable
                 self._scratch_cache = _lane_cache_copy_jit(
                     self._bstate["cache"], jnp.int32(src))
-                self._prefix_stats["lane_prefix_hits"] += 1
-                self._prefix_stats["lane_prefix_reused_tokens"] += reuse
+                # stats are counted in _finish_admission: an item abandoned
+                # mid-prefill (or failing later) must not inflate /metrics
             return {
                 "item": item, "ids": ids, "n_prompt": len(ids),
                 "bucket": bucket,
@@ -501,6 +508,9 @@ class ContinuousEngine(MeshEngine):
             slot.sp = item.sp
             slot.t_admit = adm["t0"]
             slot.reused = adm.get("reused", 0)
+            if slot.reused:     # count only realized reuse (lane written)
+                self._prefix_stats["lane_prefix_hits"] += 1
+                self._prefix_stats["lane_prefix_reused_tokens"] += slot.reused
             if any(s is not None for s in slots):
                 try:
                     token.copy_to_host_async()
@@ -532,7 +542,7 @@ class ContinuousEngine(MeshEngine):
             slot.first_token = int(slot.first_token)
         except Exception as e:  # noqa: BLE001 — per-request isolation
             slot.finished = True
-            self._free_lane(lane, slot, slots)
+            self._free_lane(lane, slot, slots, claim=False)
             if slot.sink is not None:
                 slot.sink.put(e)
             elif not slot.future.done():
