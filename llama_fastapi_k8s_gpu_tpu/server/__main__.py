@@ -11,6 +11,20 @@ import os
 
 
 def main():
+    # The reference scales with `gunicorn -w N` (reference
+    # docker/Dockerfile.app:12).  On TPU that is the wrong axis: a chip
+    # admits ONE claimant process, and N workers would load N copies of
+    # the model.  The analogue is in-process lanes (LFKT_BATCH_SIZE, one
+    # weight-read serving up to B decode tokens) on one chip, and k8s
+    # `replicas` across chips (helm/values.yaml) — so any request for >1
+    # worker is refused loudly instead of silently serialized.
+    workers = int(os.environ.get("LFKT_WORKERS", "1"))
+    if workers != 1:
+        raise SystemExit(
+            f"LFKT_WORKERS={workers} refused: one worker per process is "
+            "load-bearing (a TPU chip admits a single claimant; the model "
+            "loads once per process). Scale concurrency with "
+            "LFKT_BATCH_SIZE lanes on one chip, or replicas across chips.")
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # a site hook may pre-register a device platform and override the
         # env var at startup; the post-import config update wins if no
