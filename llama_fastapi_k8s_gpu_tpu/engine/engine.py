@@ -126,12 +126,17 @@ class Engine:
         self.n_ctx = n_ctx
         self.decode_chunk = decode_chunk
         self.max_gen_tokens = max_gen_tokens
-        if spec_decode not in ("off", "lookup"):
+        if spec_decode not in ("off", "lookup", "auto"):
             raise ValueError(
-                f"spec_decode must be off|lookup, got {spec_decode!r}")
-        if spec_decode == "lookup" and not 1 <= spec_draft < n_ctx - 1:
+                f"spec_decode must be off|lookup|auto, got {spec_decode!r}")
+        if spec_decode != "off" and not 1 <= spec_draft < n_ctx - 1:
             raise ValueError(
                 f"spec_draft must be in [1, n_ctx-2], got {spec_draft}")
+        # "auto" resolves AFTER params load (the decision needs the model's
+        # per-token HBM bytes + a measured dispatch RTT) — engine/spec_auto.py
+        self._spec_request = spec_decode
+        self._spec_draft_request = spec_draft
+        self.spec_auto_decision: dict | None = None
         self._spec_draft = spec_draft if spec_decode == "lookup" else 0
         if self._spec_draft and type(self) is not Engine \
                 and not getattr(self, "_SPEC_LANES", False):
@@ -234,6 +239,14 @@ class Engine:
                 attn_impl = "xla"
         if attn_impl != self.cfg.attn_impl:
             self.cfg = dataclasses.replace(self.cfg, attn_impl=attn_impl)
+        if self._spec_request == "auto":
+            from .spec_auto import resolve_auto
+
+            mode, self.spec_auto_decision = resolve_auto(self.params)
+            self._spec_draft = (self._spec_draft_request
+                                if mode == "lookup" else 0)
+            logger.info("spec_decode=auto resolved to %r: %s", mode,
+                        self.spec_auto_decision)
         self.prefill_buckets = sorted(b for b in prefill_buckets if b <= self.cfg.n_ctx)
         if not self.prefill_buckets or self.prefill_buckets[-1] < self.cfg.n_ctx:
             self.prefill_buckets.append(self.cfg.n_ctx)

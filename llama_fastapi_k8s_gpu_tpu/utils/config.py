@@ -61,7 +61,12 @@ class Settings:
     prefill_buckets: str = "128,256,512,1024"  # padded prompt shapes to bound recompiles
     weight_format: str = "auto"     # auto | bf16 | int8 | q4k
     attn_impl: str = "auto"         # auto | xla | pallas (prefill flash kernel)
-    spec_decode: str = "off"        # off | lookup — prompt-lookup speculative
+    spec_decode: str = "off"        # off | lookup | auto — prompt-lookup
+    #                                 speculation; "auto" measures the
+    #                                 deployment's dispatch RTT at startup
+    #                                 and enables lookup iff its breakeven
+    #                                 acceptance < LFKT_SPEC_AUTO_ACCEPT
+    #                                 (engine/spec_auto.py)
     spec_draft: int = 8             # draft tokens per verify step
     # serial-engine prompt-prefix KV reuse (llama.cpp's prompt-cache
     # analogue): when consecutive prompts share a token prefix — the
