@@ -62,14 +62,12 @@ def main() -> None:
     spec_draft = int(os.environ.get("LFKT_SPEC_DRAFT", "8"))
     fullctx = os.environ.get("LFKT_BENCH_FULLCTX") == "1"
     multiturn = os.environ.get("LFKT_BENCH_MULTITURN") == "1"
+    lane_prefix = os.environ.get("LFKT_LANE_PREFIX_CACHE", "").lower() in (
+        "1", "true", "yes")
     if multiturn:
         # turn 1 is the no-reuse baseline and follow-ups are the sample;
         # fewer than 2 turns leaves nothing to report
         n_req = max(2, n_req)
-        if int(os.environ.get("LFKT_BENCH_BATCH", "1")) > 1:
-            raise SystemExit("LFKT_BENCH_MULTITURN measures the serial "
-                             "engine's prompt-prefix reuse; unset "
-                             "LFKT_BENCH_BATCH (lane engines keep reuse off)")
 
     if preset == "tiny":
         cfg = ModelConfig(vocab_size=0, dim=128, n_layers=2, n_heads=8,
@@ -114,7 +112,16 @@ def main() -> None:
             params, cfg, tok, template_kind="llama3",
             max_gen_tokens=max_tokens, attn_impl=cfg.attn_impl,
             dp=1, batch_size=batch,
-            spec_decode=spec_decode, spec_draft=spec_draft)
+            spec_decode=spec_decode, spec_draft=spec_draft,
+            # the lane-prefix A/B knobs (VERDICT r4 #8): without explicit
+            # plumbing the envs would be read by Settings only, and this
+            # bench builds its engine directly — the +prefix arm would
+            # silently measure the reuse-free scheduler again.  The
+            # admission slice size matters to the A/B too: reuse is
+            # chunk-aligned, so a 256-token slice needs 256 shared tokens
+            # before the first claim pays.
+            lane_prefix_cache=lane_prefix,
+            prefill_chunk=int(os.environ.get("LFKT_PREFILL_CHUNK", "256")))
     else:
         # prefix reuse stays OFF for the standard phases: they re-POST a
         # byte-identical payload n_req times, so the serial engine's
@@ -212,14 +219,18 @@ def main() -> None:
         return out
 
     def stream_ttft(body: bytes):
-        """POST /response/stream; returns (ttft_ms, full_text).  Drains the
-        stream fully (an abandoned generation runs to completion and would
-        queue under the next sample's TTFT)."""
+        """POST /response/stream; returns (ttft_ms, full_text, error).
+        Drains the stream fully (an abandoned generation runs to completion
+        and would queue under the next sample's TTFT).  ``error`` is the
+        server's SSE error event text (context overflow, timeout) or None —
+        callers must stop measuring a conversation once it errors, or every
+        later "sample" is a fast error round trip mislabeled as TTFT."""
         req = urllib.request.Request(
             base + "/response/stream", data=body,
             headers={"Content-Type": "application/json"})
         t0 = time.perf_counter()
         first = None
+        err = None
         parts: list[str] = []
         with urllib.request.urlopen(req, timeout=600) as r:
             for raw in r:
@@ -229,7 +240,11 @@ def main() -> None:
                 body_ln = line[5:].strip()
                 if body_ln == "[DONE]":
                     break
-                delta = json.loads(body_ln)["choices"][0]["delta"]
+                evt = json.loads(body_ln)
+                if "error" in evt:
+                    err = str(evt["error"])
+                    break
+                delta = evt["choices"][0]["delta"]
                 c = delta.get("content")
                 if c:
                     if first is None:
@@ -237,7 +252,107 @@ def main() -> None:
                     parts.append(c)
         if first is None:
             first = (time.perf_counter() - t0) * 1e3
-        return first, "".join(parts)
+        return first, "".join(parts), err
+
+    if multiturn and batch > 1:
+        # LFKT_BENCH_MULTITURN=1 + LFKT_BENCH_BATCH=C: C concurrent growing
+        # conversations through the lane scheduler — the workload the
+        # lane-prefix cache exists for (VERDICT r4 #8's "multiturn client
+        # mix").  Each follow-up re-sends persona + full history; with
+        # LFKT_LANE_PREFIX_CACHE=1 admission finds the freed lane still
+        # holding that conversation's KV and prefills only the suffix.
+        # Distinct openers keep claims conversation-specific (the shared
+        # persona tokens are legitimate cross-conversation reuse).
+        followups = [
+            "Interesting, tell me more.", "Why is that?", "Go on.",
+            "What happened next?", "Could you expand on that?",
+        ]
+        turns = int(os.environ.get("LFKT_BENCH_TURNS", "4"))
+        turn1, follow = [], []
+        lk = threading.Lock()
+
+        completed = []
+        errors = []
+
+        def convo_worker(cid: int):
+            convo = [{"turn": "user",
+                      "message": f"Hello bot {cid}! Introduce yourself "
+                                 "briefly."}]
+            done = 0
+            for t in range(turns):
+                body = json.dumps({
+                    "bot_profile": {
+                        "name": "Ada",
+                        "appearance": "tall, green eyes, red hair, calm voice",
+                        "system_prompt": "You are a concise assistant.",
+                    },
+                    "user_profile": {"name": "Sam"},
+                    "context": convo,
+                }).encode()
+                try:
+                    ms, text, err = stream_ttft(body)
+                except Exception as e:  # noqa: BLE001 — transport failure
+                    with lk:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    break
+                if err is not None:
+                    # conversation outgrew the context (or timed out):
+                    # stop HERE — the turns measured so far are valid
+                    with lk:
+                        errors.append(err)
+                    break
+                done += 1
+                with lk:
+                    (turn1 if t == 0 else follow).append(ms)
+                convo.append({"turn": "bot", "message": (text or "...")[:400]})
+                convo.append({"turn": "user",
+                              "message": followups[(cid + t) % len(followups)]})
+            with lk:
+                completed.append(done)
+
+        names = ("scheduler_lane_prefix_hits",
+                 "scheduler_lane_prefix_reused_tokens")
+        before = read_metrics_counters(names)
+        t_mt = time.perf_counter()
+        ths = [threading.Thread(target=convo_worker, args=(c,))
+               for c in range(batch)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        mt_s = time.perf_counter() - t_mt
+        after = read_metrics_counters(names)
+        follow.sort()
+        turn1.sort()
+        pq = lambda v, q: v[min(len(v) - 1, int(q * len(v)))]  # noqa: E731
+        result = {
+            "metric": (f"server_ttft_ms_p50[/response,{preset},{wfmt}"
+                       f",multiturn,batch{batch}"
+                       + (",laneprefix]" if lane_prefix else "]")),
+            "value": round(pq(follow, 0.5), 1) if follow else 0.0,
+            "unit": "ms",
+            "vs_baseline": (round(A10G_TTFT_MS / pq(follow, 0.5), 3)
+                            if follow else 0.0),
+            "ttft_ms_p95_server": (round(pq(follow, 0.95), 1)
+                                   if follow else None),
+            "turn1_ttft_ms_p50": round(pq(turn1, 0.5), 1) if turn1 else None,
+            "follow_samples": len(follow),
+            "conversations": batch,
+            "turns": turns,
+            "turns_completed": sorted(completed),
+            "stream_errors": errors[:8],
+            "max_tokens": max_tokens,
+            "warmup_s": round(warm_s, 1),
+            "lane_prefix_cache": lane_prefix,
+            "lane_prefix": (
+                {k: after[k] - before[k] for k in names}
+                if before is not None and after is not None else None),
+            "scheduler_stats": eng.scheduler_stats(),
+            "wall_s": round(mt_s, 1),
+            "device": str(dev),
+        }
+        print(json.dumps(result), flush=True)
+        os._exit(0)  # daemon server thread: skip graceful asyncio teardown
 
     if multiturn:
         # LFKT_BENCH_MULTITURN=1: ONE growing conversation — each request
@@ -280,9 +395,15 @@ def main() -> None:
             got = read_metrics_counters(("prefix_cache_reused_tokens_total",))
             return None if got is None else got["prefix_cache_reused_tokens_total"]
 
+        mt_errors = []
         for k in range(n_req):
             r_before = reused_total()
-            ms, text = stream_ttft(mt_payload())
+            ms, text, err = stream_ttft(mt_payload())
+            if err is not None:
+                # conversation outgrew the context: stop measuring (later
+                # "samples" would be fast error round trips, not TTFT)
+                mt_errors.append(err)
+                break
             r_after = reused_total()
             per_turn.append({
                 "turn": k + 1, "ttft_ms": round(ms, 1),
@@ -304,12 +425,17 @@ def main() -> None:
         result = {
             "metric": (f"server_ttft_ms_p50[/response,{preset},{wfmt}"
                        ",multiturn]"),
-            "value": round(pq(follow, 0.5), 1),
+            "value": round(pq(follow, 0.5), 1) if follow else 0.0,
             "unit": "ms",
-            "vs_baseline": round(A10G_TTFT_MS / max(pq(follow, 0.5), 1e-9), 3),
-            "ttft_ms_p95_server": round(pq(follow, 0.95), 1),
-            "turn1_ttft_ms": round(first_ttft, 1),
+            "vs_baseline": (round(A10G_TTFT_MS / pq(follow, 0.5), 3)
+                            if follow else 0.0),
+            "ttft_ms_p95_server": (round(pq(follow, 0.95), 1)
+                                   if follow else None),
+            "turn1_ttft_ms": (round(first_ttft, 1)
+                              if first_ttft is not None else None),
             "turns": n_req,
+            "turns_measured": len(per_turn),
+            "stream_errors": mt_errors,
             "max_tokens": max_tokens,
             "warmup_s": round(warm_s, 1),
             "prefix_cache": counters,
@@ -328,8 +454,12 @@ def main() -> None:
 
     ttft = []
     for _ in range(n_req):
-        ms, _text = stream_ttft(payload)
-        ttft.append(ms)
+        ms, _text, err = stream_ttft(payload)
+        if err is None:     # fixed warmed payload: errors are unexpected —
+            ttft.append(ms)  # drop the sample rather than time the error path
+        else:
+            print(f"bench_server: stream error during TTFT phase: {err}",
+                  file=sys.stderr, flush=True)
 
     # concurrent load (BASELINE config #5: "concurrent /response load ...
     # back-pressure"): fan out parallel POSTs; the server queues up to 5 and
@@ -403,6 +533,7 @@ def main() -> None:
         "metric": (f"server_ttft_ms_p50[/response,{preset},{wfmt}"
                    + (",fullctx" if fullctx else "")
                    + (",spec" if spec_decode == "lookup" else "")
+                   + (",laneprefix" if lane_prefix and batch > 1 else "")
                    + (f",batch{batch}]" if batch > 1 else "]")),
         "value": round(p(ttft, 0.5), 1),
         "unit": "ms",

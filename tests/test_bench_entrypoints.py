@@ -47,6 +47,29 @@ def test_bench_server_tiny_smoke():
     assert parsed["concurrent"]["agg_tok_s"] > 0
 
 
+def test_bench_server_batch_multiturn_smoke():
+    """The lane-prefix A/B mode (LFKT_BENCH_MULTITURN x LFKT_BENCH_BATCH)
+    must emit valid JSON with complete conversations and the engine-level
+    scheduler stats.  (Reuse itself can't show at tiny scale: n_ctx 256
+    can't hold a persona + 400-char-clip history, so history either
+    overflows or is truncated away — the mechanism is pinned at engine
+    level in tests/test_continuous.py.)"""
+    parsed, out = _run("bench_server.py",
+                       extra_env={"LFKT_BENCH_MULTITURN": "1",
+                                  "LFKT_BENCH_BATCH": "2",
+                                  "LFKT_LANE_PREFIX_CACHE": "1",
+                                  "LFKT_PREFILL_CHUNK": "16",
+                                  "LFKT_BENCH_TURNS": "3",
+                                  "LFKT_BENCH_MAX_TOKENS": "12",
+                                  "LFKT_MAX_CONTEXT_TOKENS": "100",
+                                  "LFKT_BENCH_PORT": "8042"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert parsed["value"] > 0
+    assert parsed["turns_completed"] == [3, 3], parsed
+    assert parsed["stream_errors"] == [], parsed
+    assert "lane_prefix_hits" in parsed["scheduler_stats"], parsed
+
+
 def test_synth_q4km_layouts_match_prep():
     """The q4km synthetic grid must stay layout-identical (pytree keys,
     shapes, dtypes) to what models/params.py builds from a real Q4_K_M

@@ -95,11 +95,19 @@ step bench_server_fullctx env LFKT_BENCH_FULLCTX=1 python bench_server.py
 # 6) multiturn conversation: prompt-prefix KV reuse through the stack
 step bench_server_multiturn env LFKT_BENCH_MULTITURN=1 python bench_server.py
 
-# 7) 8-lane aggregate: plain / +lane-prefix reuse / +spec decode
+# 7) 8-lane aggregate (budgeted admission, ≥220 tok/s target) + spec arm
 step bench_server_batch8 env LFKT_BENCH_BATCH=8 python bench_server.py
-step bench_server_batch8_prefix env LFKT_BENCH_BATCH=8 \
-  LFKT_LANE_PREFIX_CACHE=1 python bench_server.py
 step bench_server_batch8_spec env LFKT_BENCH_BATCH=8 LFKT_SPEC_DECODE=lookup \
+  python bench_server.py
+# 7b) lane-prefix A/B under the MULTITURN client (8 concurrent growing
+#     conversations — the workload the cache exists for, VERDICT r4 #8)
+#     Both arms run the same 64-token admission slice: reuse claims are
+#     chunk-aligned, so the default 256 slice would need 256 shared tokens
+#     before the first claim fires on these short conversations.
+step bench_server_mtbatch8 env LFKT_BENCH_MULTITURN=1 LFKT_BENCH_BATCH=8 \
+  LFKT_PREFILL_CHUNK=64 python bench_server.py
+step bench_server_mtbatch8_prefix env LFKT_BENCH_MULTITURN=1 \
+  LFKT_BENCH_BATCH=8 LFKT_PREFILL_CHUNK=64 LFKT_LANE_PREFIX_CACHE=1 \
   python bench_server.py
 
 # 8) Mistral-7B (BASELINE config #4): reference operating point + the 8k
