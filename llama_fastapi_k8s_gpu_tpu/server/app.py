@@ -587,7 +587,11 @@ def create_app(engine=None, settings: Settings | None = None,
         stats = getattr(app.state.engine, "scheduler_stats", None)
         if stats is not None:
             for k, v in stats().items():
-                m.set_gauge(f"scheduler_{k}", v)
+                if isinstance(v, dict):   # nested stats (e.g. spec): flatten
+                    for kk, vv in v.items():  # — a dict-valued gauge renders
+                        m.set_gauge(f"scheduler_{k}_{kk}", vv)  # invalid lines
+                else:
+                    m.set_gauge(f"scheduler_{k}", v)
         return PlainTextResponse(m.render())
 
     @app.get("/items/{item_id}")

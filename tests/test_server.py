@@ -151,6 +151,26 @@ async def test_health_and_metrics_and_items():
         await app.router.shutdown()
 
 
+@pytest.mark.anyio
+async def test_metrics_flattens_nested_scheduler_stats():
+    """Dict-valued scheduler stats (spec telemetry) must flatten into one
+    gauge per leaf — a dict rendered verbatim is an invalid exposition
+    line every Prometheus scraper (and bench parser) drops."""
+    engine = FakeEngine()
+    engine.scheduler_stats = lambda: {
+        "lanes_live": 1, "spec": {"drafted": 5, "accepted": 3}}
+    app, transport = make_client(engine)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            m = await client.get("/metrics")
+            assert "scheduler_lanes_live 1" in m.text
+            assert "scheduler_spec_drafted 5" in m.text
+            assert "scheduler_spec_accepted 3" in m.text
+            assert "{" not in m.text
+        await app.router.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # pure-function behavior parity (reference api.py:30-46, 127-147)
 # ---------------------------------------------------------------------------
