@@ -52,15 +52,24 @@ def _tensor_to_device(t, dtype=jnp.float32) -> jax.Array:
     return flat.reshape(tuple(reversed(t.shape)))
 
 
-def _stack(dicts: list[dict]) -> dict:
-    """List of identically-keyed (possibly nested) dicts → dict of stacked arrays."""
+def _stack(dicts: list[dict], free: bool = False) -> dict:
+    """List of identically-keyed (possibly nested) dicts → dict of stacked
+    arrays.  ``free=True`` drops each per-layer ref as soon as its stacked
+    leaf exists (overlap mode: the inputs are device arrays, so holding all
+    of them through the whole stack would double device-memory peak; with
+    progressive freeing the peak is ~1× weights + the largest single
+    name's stack)."""
     out = {}
     for key in dicts[0]:
         vals = [d[key] for d in dicts]
         if isinstance(vals[0], dict):
-            out[key] = _stack(vals)
+            out[key] = _stack(vals, free=free)
         else:
             out[key] = jnp.stack(vals)
+            if free:
+                del vals
+                for d in dicts:
+                    d[key] = None
     return out
 
 
@@ -167,11 +176,23 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     def norm(name: str):
         return jnp.asarray(gf[name].astype_f32(), dtype=jnp.float32)
 
+    # LFKT_LOAD_OVERLAP=1: enqueue each layer's host→device transfer the
+    # moment its planes are packed, so the (async) transfers stream while
+    # the C++ packers prep the NEXT layers, instead of serializing all
+    # packing before all transfer (the default _stack(host arrays) order).
+    # The final stack then concatenates resident device arrays.  Off by
+    # default until the coldstart A/B lands (the phase split in
+    # coldstart_*.json decides whether transfer time is worth hiding).
+    import os as _os
+
+    overlap = _os.environ.get("LFKT_LOAD_OVERLAP", "0").lower() in (
+        "1", "true", "yes")
+
     layers = []
     t0 = _time.time()
     for i in range(cfg.n_layers):
         p = f"blk.{i}."
-        layers.append({
+        layer = {
             "attn_norm": norm(p + "attn_norm.weight"),
             "wq": lin(p + "attn_q.weight"),
             "wk": lin(p + "attn_k.weight"),
@@ -181,7 +202,10 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
             "w_gate": lin(p + "ffn_gate.weight"),
             "w_up": lin(p + "ffn_up.weight"),
             "w_down": lin(p + "ffn_down.weight"),
-        })
+        }
+        if overlap:
+            layer = jax.tree.map(jax.device_put, layer)
+        layers.append(layer)
         logger.debug("loaded layer %d/%d", i + 1, cfg.n_layers)
     phase_s["prep"] = _time.time() - t0
 
@@ -194,7 +218,7 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     else:
         output = lin("output.weight")
     t0 = _time.time()
-    stacked = _stack(layers)
+    stacked = _stack(layers, free=overlap)
     jax.block_until_ready(stacked)   # best-effort on the tunneled platform;
     #                                  coldstart_main times load externally
     phase_s["stack"] = _time.time() - t0

@@ -205,3 +205,28 @@ def test_load_params_on_device_matches_host(tmp_path, fmt):
             np.testing.assert_allclose(d32, h32, rtol=1e-6, err_msg=str(path_h))
         else:
             np.testing.assert_array_equal(d32, h32, err_msg=str(path_h))
+
+
+@pytest.mark.parametrize("fmt", ["bf16", "int8"])
+def test_load_params_overlap_matches_default(tmp_path, fmt, monkeypatch):
+    """LFKT_LOAD_OVERLAP=1 (per-layer async device_put + device-side stack,
+    progressive freeing) must produce a bitwise-identical pytree to the
+    default host-side stack order."""
+    from llama_fastapi_k8s_gpu_tpu.gguf import GGUFFile
+    from llama_fastapi_k8s_gpu_tpu.models.params import load_params
+    from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+
+    path = str(tmp_path / "tiny-ov.gguf")
+    cfg = write_tiny_llama_gguf(path, quant=GGMLType.Q4_K,
+                                ffn_quant=GGMLType.Q6_K)
+    gf = GGUFFile(path)
+    monkeypatch.delenv("LFKT_LOAD_OVERLAP", raising=False)
+    base = load_params(gf, cfg, fmt=fmt, on_device=False)
+    monkeypatch.setenv("LFKT_LOAD_OVERLAP", "1")
+    over = load_params(gf, cfg, fmt=fmt, on_device=False)
+    flat_b, tree_b = jax.tree.flatten_with_path(base)
+    flat_o, tree_o = jax.tree.flatten_with_path(over)
+    assert tree_b == tree_o
+    for (p, b), (_, o) in zip(flat_b, flat_o):
+        assert b.dtype == o.dtype and b.shape == o.shape, p
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(o), err_msg=str(p))
