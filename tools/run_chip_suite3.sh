@@ -84,9 +84,12 @@ if grep -q '^export' /tmp/lfkt_kernel_env.sh; then
     step bench_q4km_variant_ab python bench.py )
 fi
 
-# 4) cold start: pre-written file, load only, generous ceiling
+# 4) cold start: pre-written file, load only, generous ceiling — then the
+#    transfer/pack-overlap arm (LFKT_LOAD_OVERLAP) as an in-suite A/B
 python tools/write_coldstart_gguf.py >&2 || true   # no-op if file exists
 step coldstart env LFKT_BENCH_COLDSTART=1 LFKT_COLDSTART_REUSE=1 python bench.py
+step coldstart_overlap env LFKT_BENCH_COLDSTART=1 LFKT_COLDSTART_REUSE=1 \
+  LFKT_LOAD_OVERLAP=1 python bench.py
 
 # 5) server TTFT, short + full-context (1024-token bucket, VERDICT r4 #6)
 step bench_server_short python bench_server.py
