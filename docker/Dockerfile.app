@@ -9,6 +9,11 @@ RUN pip install --no-cache-dir -r /app/requirements.txt
 COPY llama_fastapi_k8s_gpu_tpu /app/llama_fastapi_k8s_gpu_tpu
 RUN mkdir -p /app/models
 
+# Persistent XLA compile cache: restarts of the same container (or a
+# mounted volume — helm compileCache.*) skip jit warmup recompiles.
+ENV LFKT_COMPILE_CACHE_DIR=/xla-cache
+RUN mkdir -p /xla-cache
+
 # Exactly one worker: the model is loaded once per process (reference
 # Dockerfile.app:12 `gunicorn -w 1`); the module entrypoint enforces it.
 CMD ["python", "-m", "llama_fastapi_k8s_gpu_tpu.server"]

@@ -571,6 +571,10 @@ def create_app(engine=None, settings: Settings | None = None,
                 "attn_impl": getattr(cfg, "attn_impl", None),
                 "weight_formats": fmt,
             }
+            # spec_decode="auto": the measured-RTT decision and its inputs
+            # (engine/spec_auto.py) — operators verify the resolution here
+            if getattr(eng, "spec_auto_decision", None) is not None:
+                engine_info["spec_auto"] = eng.spec_auto_decision
         return {
             "status": "ok",
             "model_loaded": eng is not None,
