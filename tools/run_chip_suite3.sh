@@ -35,6 +35,20 @@ bash tools/tpu_probe.sh /tmp/tpu_probe_suite3.log
 echo "=== probe ok ($(date +%T)) ===" >&2
 sleep 10   # let the probe's claim fully release
 
+# The driver's end-of-round bench needs the chip to itself (a second 0.0
+# BENCH record would repeat round 4's failure).  If the tunnel only came
+# back near the end of the round, run a reduced step list and leave the
+# window clear: TIER 2 (≲3h left) = headline, microbench, coldstart,
+# fullctx; TIER 1 (≲70min left) = headline only; past the hard cutoff =
+# bank nothing, the driver's own bench.py run IS the headline.
+ROUND_END=${LFKT_ROUND_END_EPOCH:-1785555600}   # 2026-08-01 03:40 UTC
+left=$(( ROUND_END - $(date +%s) ))
+TIER=3
+[ "$left" -lt 10800 ] && TIER=2
+[ "$left" -lt 4200 ] && TIER=1
+[ "$left" -lt 1500 ] && { echo "=== ${left}s left: ceding the chip to the driver bench ===" >&2; exit 0; }
+echo "=== ${left}s left before driver window: tier $TIER ===" >&2
+
 step() {
   local name="$1"; shift
   echo "=== $name ($(date +%T)) ===" >&2
@@ -47,6 +61,7 @@ step() {
 
 # 1) bank the headline FIRST (current defaults)
 step bench_q4km_headline python bench.py
+[ "$TIER" -le 1 ] && { echo "=== tier-1 done ===" >&2; exit 0; }
 
 # 2) kernel-variant microbench: every Q*_VARIANTS entry vs roofline + the
 #    on-chip numerics gate (dev_fail rows are never selectable)
@@ -94,6 +109,7 @@ step coldstart_overlap env LFKT_BENCH_COLDSTART=1 LFKT_COLDSTART_REUSE=1 \
 # 5) server TTFT, short + full-context (1024-token bucket, VERDICT r4 #6)
 step bench_server_short python bench_server.py
 step bench_server_fullctx env LFKT_BENCH_FULLCTX=1 python bench_server.py
+[ "$TIER" -le 2 ] && { echo "=== tier-2 done ===" >&2; exit 0; }
 
 # 6) multiturn conversation: prompt-prefix KV reuse through the stack
 step bench_server_multiturn env LFKT_BENCH_MULTITURN=1 python bench_server.py
