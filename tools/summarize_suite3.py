@@ -1,0 +1,107 @@
+"""Summarize round-5 chip-suite artifacts into PERF.md-ready lines.
+
+Reads every ``docs/bench/<step>_<date>.json`` the suite wrote today (or the
+date given as argv[1]), prints one compact line per artifact plus the
+decisions they gate: kernel-default flip (microbench winner vs shipped
+default), coldstart overlap A/B, lane-prefix A/B, spec acceptance, and the
+Helm startup-probe budget implied by the measured coldstart.
+
+Usage: python tools/summarize_suite3.py [YYYY-MM-DD]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import sys
+import time
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "docs", "bench")
+DEFAULTS = {"q4k": "cur", "q5k": "cur", "q6k": "parfloor"}
+
+
+def load(step: str, date: str):
+    path = os.path.join(OUT, f"{step}_{date}.json")
+    if not os.path.exists(path) or os.path.getsize(path) == 0:
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception as e:  # noqa: BLE001
+        return {"_unreadable": str(e)}
+
+
+def main() -> None:
+    date = sys.argv[1] if len(sys.argv) > 1 else time.strftime("%Y-%m-%d")
+    present = sorted(
+        os.path.basename(p)[: -len(f"_{date}.json")]
+        for p in glob.glob(os.path.join(OUT, f"*_{date}.json")))
+    print(f"artifacts for {date}: {present or 'NONE'}\n")
+
+    for step in present:
+        d = load(step, date)
+        if d is None or "_unreadable" in (d or {}):
+            print(f"{step}: UNREADABLE {d}")
+            continue
+        v, u = d.get("value"), d.get("unit", "")
+        extra = ""
+        if "tokens_per_sec" in d:
+            extra += f" steady={d['tokens_per_sec']} tok/s"
+        if "load_phases" in d and d["load_phases"]:
+            extra += f" phases={d['load_phases']}"
+        if "ttft_ms_p95_server" in d:
+            extra += f" p95={d['ttft_ms_p95_server']}"
+        if d.get("concurrent"):
+            extra += f" agg={d['concurrent'].get('agg_tok_s')} tok/s"
+        if d.get("spec"):
+            extra += f" spec={d['spec']}"
+        if d.get("lane_prefix"):
+            extra += f" lane_prefix={d['lane_prefix']}"
+        if d.get("scheduler_stats"):
+            extra += f" sched={d['scheduler_stats']}"
+        if d.get("error"):
+            extra += f" ERROR={d['error']}"
+        print(f"{step}: {v} {u}{extra}")
+
+    # kernel microbench: winner per fmt at B=1 geomean (gate-passing only)
+    kmb = load("kernel_microbench", date)
+    if kmb and "rows" in kmb:
+        by, bad = {}, set()
+        for r in kmb["rows"]:
+            key = (r["fmt"], r.get("variant"))
+            if r.get("dev_fail") or "error" in r or "probe_error" in r:
+                bad.add(key)
+            elif r.get("b") == 1 and "us" in r:
+                by.setdefault(key, []).append(r["us"])
+        print("\nkernel defaults (B=1 geomean, gate-passing):")
+        for fmt, default in DEFAULTS.items():
+            cands = sorted(
+                (math.exp(sum(map(math.log, ts)) / len(ts)), var)
+                for (f, var), ts in by.items()
+                if f == fmt and (f, var) not in bad)
+            if not cands:
+                continue
+            best_t, best_v = cands[0]
+            mark = (f"  -> FLIP {fmt} default {default} -> {best_v}"
+                    if best_v != default else "  (default holds)")
+            row = ", ".join(f"{v}={t:.1f}us" for t, v in cands)
+            print(f"  {fmt}: {row}{mark}")
+
+    # coldstart: probe budget + overlap A/B
+    cs, cso = load("coldstart", date), load("coldstart_overlap", date)
+    if cs and "value" in cs:
+        total = (cs["value"] or 0) + (cs.get("first_request_s") or 0)
+        print(f"\ncoldstart: load {cs['value']}s + first-req "
+              f"{cs.get('first_request_s')}s = {round(total, 1)}s -> Helm "
+              f"startupFailureThreshold ≈ {int(total / 10 * 1.5) + 1} "
+              f"(period 10s, 1.5x headroom)")
+        if cso and "value" in cso:
+            print(f"coldstart overlap A/B: {cs['value']}s -> {cso['value']}s "
+                  f"(phases {cso.get('load_phases')})")
+
+
+if __name__ == "__main__":
+    main()
