@@ -115,3 +115,52 @@ def test_two_replica_processes_serve_concurrently(tmp_path):
                 p.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_golden_transcript_reproducible_across_processes(tmp_path):
+    """SURVEY §4 "Golden/e2e": the same tiny GGUF served by two fresh
+    server processes must produce byte-identical temp=0 `/response` output
+    — the golden transcript is pinned by the model file + seed rather than
+    a hardcoded string (stable across jax versions, still catches any
+    nondeterminism in load → tokenize → prefill → sample → decode)."""
+    import urllib.request
+
+    from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+
+    write_tiny_llama_gguf(str(tmp_path / "tiny.gguf"))
+    body = json.dumps({**BODY, "context": [
+        {"turn": "user", "message": "Tell me a short story."}]}).encode()
+    replies = []
+    for port in (8033, 8034):
+        env = _env(port, str(tmp_path))
+        env["LFKT_TEMPERATURE"] = "0.0"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.server"],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        try:
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"server died:\n{proc.stderr.read().decode()[-2000:]}")
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/health", timeout=5) as r:
+                        if r.status == 200:
+                            break
+                except OSError:
+                    time.sleep(1.0)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/response", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                replies.append(json.loads(r.read())["response"])
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    assert replies[0] == replies[1]
+    assert isinstance(replies[0], str)
