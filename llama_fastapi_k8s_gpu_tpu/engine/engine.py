@@ -247,6 +247,11 @@ class Engine:
                                 if mode == "lookup" else 0)
             logger.info("spec_decode=auto resolved to %r: %s", mode,
                         self.spec_auto_decision)
+            if self._spec_draft and type(self) is not Engine \
+                    and not getattr(self, "_SPEC_LANES", False):
+                logger.warning(
+                    "spec_decode=auto resolved to lookup, but %s serves "
+                    "vanilla decode (see _spec_enabled)", type(self).__name__)
         self.prefill_buckets = sorted(b for b in prefill_buckets if b <= self.cfg.n_ctx)
         if not self.prefill_buckets or self.prefill_buckets[-1] < self.cfg.n_ctx:
             self.prefill_buckets.append(self.cfg.n_ctx)
