@@ -62,8 +62,9 @@ def main() -> None:
     spec_draft = int(os.environ.get("LFKT_SPEC_DRAFT", "8"))
     fullctx = os.environ.get("LFKT_BENCH_FULLCTX") == "1"
     multiturn = os.environ.get("LFKT_BENCH_MULTITURN") == "1"
-    lane_prefix = os.environ.get("LFKT_LANE_PREFIX_CACHE", "").lower() in (
-        "1", "true", "yes")
+    from llama_fastapi_k8s_gpu_tpu.utils.config import env_bool
+
+    lane_prefix = env_bool("LFKT_LANE_PREFIX_CACHE")
     if multiturn:
         # turn 1 is the no-reuse baseline and follow-ups are the sample;
         # fewer than 2 turns leaves nothing to report
@@ -122,6 +123,11 @@ def main() -> None:
             # before the first claim pays.
             lane_prefix_cache=lane_prefix,
             prefill_chunk=int(os.environ.get("LFKT_PREFILL_CHUNK", "256")))
+        # report the engine's REALIZED setting, not the env request: spec
+        # decode silently excludes lane-prefix reuse (continuous.py), and a
+        # ',laneprefix'-labeled artifact with reuse actually off would be a
+        # mislabeled A/B arm in the evidence ledger
+        lane_prefix = bool(getattr(eng, "_lane_prefix", False))
     else:
         # prefix reuse stays OFF for the standard phases: they re-POST a
         # byte-identical payload n_req times, so the serial engine's

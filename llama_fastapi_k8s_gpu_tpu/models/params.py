@@ -183,10 +183,9 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     # The final stack then concatenates resident device arrays.  Off by
     # default until the coldstart A/B lands (the phase split in
     # coldstart_*.json decides whether transfer time is worth hiding).
-    import os as _os
+    from ..utils.config import env_bool
 
-    overlap = _os.environ.get("LFKT_LOAD_OVERLAP", "0").lower() in (
-        "1", "true", "yes")
+    overlap = env_bool("LFKT_LOAD_OVERLAP")
 
     layers = []
     t0 = _time.time()

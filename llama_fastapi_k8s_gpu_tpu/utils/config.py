@@ -23,6 +23,14 @@ def _env(name: str, default, cast=str):
     return cast(raw)
 
 
+def env_bool(name: str, default: bool = False) -> bool:
+    """THE truthy-env convention (one parser: '1'/'true'/'yes'/'on').
+    Direct-engine-construction paths (bench_server.py, models/params.py)
+    must use this instead of re-implementing the tuple and silently
+    diverging on accepted spellings."""
+    return _env(name, default, bool)
+
+
 @dataclasses.dataclass(frozen=True)
 class Settings:
     # Identical defaults to reference api.py:13-19.

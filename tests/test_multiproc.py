@@ -140,17 +140,20 @@ def test_golden_transcript_reproducible_across_processes(tmp_path):
             stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
         try:
             deadline = time.time() + 420
-            while time.time() < deadline:
+            ready = False
+            while not ready and time.time() < deadline:
                 if proc.poll() is not None:
                     raise AssertionError(
                         f"server died:\n{proc.stderr.read().decode()[-2000:]}")
                 try:
                     with urllib.request.urlopen(
                             f"http://127.0.0.1:{port}/health", timeout=5) as r:
-                        if r.status == 200:
-                            break
+                        ready = r.status == 200
                 except OSError:
+                    pass
+                if not ready:
                     time.sleep(1.0)
+            assert ready, f"replica :{port} not healthy before deadline"
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port}/response", data=body,
                 headers={"Content-Type": "application/json"})

@@ -25,7 +25,10 @@ export LFKT_COMPILE_CACHE_DIR=${LFKT_COMPILE_CACHE_DIR:-/tmp/lfkt_xla_cache}
 # fewer, longer watchdog windows: a kill mid-claim wedges the tunnel
 export LFKT_BENCH_TOTAL_TIMEOUT=${LFKT_BENCH_TOTAL_TIMEOUT:-2700}
 
-if pgrep -f "run_chip_suite[.2]" | grep -v $$ | grep -qv pgrep; then
+# refuse a double launch of ANY suite generation (the charclass form
+# "run_chip_suite[.2]" silently failed to match this very script — two
+# suites contending for the single-session tunnel is the wedge scenario)
+if pgrep -f "run_chip_suite" | grep -v "^$$\$" | grep -qv pgrep; then
   echo "refusing to start: an earlier chip suite is still running" >&2
   exit 1
 fi
@@ -54,8 +57,16 @@ step() {
   echo "=== $name ($(date +%T)) ===" >&2
   "$@" > "$OUT/_tmp.$name.json" 2> "$OUT/_tmp.$name.err"
   local rc=$?
-  tail -1 "$OUT/_tmp.$name.json" > "$OUT/${name}_${TS}.json"
-  echo "rc=$rc $(head -c 200 "$OUT/${name}_${TS}.json")" >&2
+  # bank the artifact ONLY when the child succeeded and its last line is
+  # valid JSON — a failed bench must leave scratch, not a 0-byte/garbage
+  # dated artifact (the class MANIFEST.md says is deleted, not kept)
+  if [ $rc -eq 0 ] && tail -1 "$OUT/_tmp.$name.json" | python -c \
+      'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
+    tail -1 "$OUT/_tmp.$name.json" > "$OUT/${name}_${TS}.json"
+    echo "rc=0 $(head -c 200 "$OUT/${name}_${TS}.json")" >&2
+  else
+    echo "rc=$rc NOT BANKED (see _tmp.$name.err): $(tail -c 200 "$OUT/_tmp.$name.err")" >&2
+  fi
   sleep 10
 }
 
@@ -68,31 +79,9 @@ step bench_q4km_headline python bench.py
 step kernel_microbench python tools/kernel_microbench.py
 
 # 3) engine-level A/B iff a gate-passing variant beats the shipped default
-python - "$OUT/kernel_microbench_${TS}.json" > /tmp/lfkt_kernel_env.sh <<'EOF'
-import json, math, sys
-DEFAULTS = {"q4k": "cur", "q5k": "cur", "q6k": "parfloor"}
-KNOB = {"q4k": "LFKT_Q4K_KERNEL", "q5k": "LFKT_Q5K_KERNEL",
-        "q6k": "LFKT_Q6K_KERNEL"}
-try:
-    rows = json.load(open(sys.argv[1]))["rows"]
-except Exception as e:
-    print(f"# picker: unreadable artifact ({e})")
-    raise SystemExit
-by, bad = {}, set()
-for r in rows:
-    key = (r["fmt"], r.get("variant"))
-    if r.get("dev_fail") or "error" in r or "probe_error" in r:
-        bad.add(key)
-    elif r.get("b") == 1 and "us" in r:
-        by.setdefault(key, []).append(r["us"])
-for fmt, default in DEFAULTS.items():
-    cands = sorted(
-        (math.exp(sum(map(math.log, ts)) / len(ts)), var)
-        for (f, var), ts in by.items() if f == fmt and (f, var) not in bad)
-    if cands and cands[0][1] != default:
-        print(f"export {KNOB[fmt]}={cands[0][1]}"
-              f"  # geomean {cands[0][0]:.1f} us vs default")
-EOF
+#    (ONE picker, shared with the post-suite summary: tools/summarize_suite3.py)
+python tools/summarize_suite3.py --emit-env \
+  "$OUT/kernel_microbench_${TS}.json" > /tmp/lfkt_kernel_env.sh
 cat /tmp/lfkt_kernel_env.sh >&2
 if grep -q '^export' /tmp/lfkt_kernel_env.sh; then
   ( . /tmp/lfkt_kernel_env.sh

@@ -6,7 +6,12 @@ decisions they gate: kernel-default flip (microbench winner vs shipped
 default), coldstart overlap A/B, lane-prefix A/B, spec acceptance, and the
 Helm startup-probe budget implied by the measured coldstart.
 
-Usage: python tools/summarize_suite3.py [YYYY-MM-DD]
+Usage:
+    python tools/summarize_suite3.py [YYYY-MM-DD]
+    python tools/summarize_suite3.py --emit-env <microbench.json>
+        # prints `export LFKT_Q*_KERNEL=<winner>` lines for gate-passing
+        # winners that differ from the shipped defaults — the ONE picker
+        # both this summary and run_chip_suite3.sh's A/B step use.
 """
 
 from __future__ import annotations
@@ -21,6 +26,39 @@ import time
 OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "docs", "bench")
 DEFAULTS = {"q4k": "cur", "q5k": "cur", "q6k": "parfloor"}
+KNOB = {"q4k": "LFKT_Q4K_KERNEL", "q5k": "LFKT_Q5K_KERNEL",
+        "q6k": "LFKT_Q6K_KERNEL"}
+
+
+def pick_winners(rows) -> dict:
+    """fmt → sorted [(geomean_us, variant), ...] over B=1 cells, excluding
+    any variant with a dev_fail / error / probe_error row on ANY shape."""
+    by, bad = {}, set()
+    for r in rows:
+        key = (r["fmt"], r.get("variant"))
+        if r.get("dev_fail") or "error" in r or "probe_error" in r:
+            bad.add(key)
+        elif r.get("b") == 1 and "us" in r:
+            by.setdefault(key, []).append(r["us"])
+    return {
+        fmt: sorted(
+            (math.exp(sum(map(math.log, ts)) / len(ts)), var)
+            for (f, var), ts in by.items() if f == fmt and (f, var) not in bad)
+        for fmt in DEFAULTS
+    }
+
+
+def emit_env(path: str) -> None:
+    """Print export lines for winners that differ from shipped defaults."""
+    try:
+        rows = json.load(open(path))["rows"]
+    except Exception as e:  # noqa: BLE001 — a broken artifact must not
+        print(f"# picker: unreadable artifact ({e})")   # fail the suite step
+        return
+    for fmt, cands in pick_winners(rows).items():
+        if cands and cands[0][1] != DEFAULTS[fmt]:
+            print(f"export {KNOB[fmt]}={cands[0][1]}"
+                  f"  # geomean {cands[0][0]:.1f} us vs default")
 
 
 def load(step: str, date: str):
@@ -69,22 +107,12 @@ def main() -> None:
     # kernel microbench: winner per fmt at B=1 geomean (gate-passing only)
     kmb = load("kernel_microbench", date)
     if kmb and "rows" in kmb:
-        by, bad = {}, set()
-        for r in kmb["rows"]:
-            key = (r["fmt"], r.get("variant"))
-            if r.get("dev_fail") or "error" in r or "probe_error" in r:
-                bad.add(key)
-            elif r.get("b") == 1 and "us" in r:
-                by.setdefault(key, []).append(r["us"])
         print("\nkernel defaults (B=1 geomean, gate-passing):")
-        for fmt, default in DEFAULTS.items():
-            cands = sorted(
-                (math.exp(sum(map(math.log, ts)) / len(ts)), var)
-                for (f, var), ts in by.items()
-                if f == fmt and (f, var) not in bad)
+        for fmt, cands in pick_winners(kmb["rows"]).items():
             if not cands:
                 continue
             best_t, best_v = cands[0]
+            default = DEFAULTS[fmt]
             mark = (f"  -> FLIP {fmt} default {default} -> {best_v}"
                     if best_v != default else "  (default holds)")
             row = ", ".join(f"{v}={t:.1f}us" for t, v in cands)
@@ -104,4 +132,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--emit-env":
+        emit_env(sys.argv[2])
+    else:
+        main()
