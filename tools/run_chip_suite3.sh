@@ -25,13 +25,22 @@ export LFKT_COMPILE_CACHE_DIR=${LFKT_COMPILE_CACHE_DIR:-/tmp/lfkt_xla_cache}
 # fewer, longer watchdog windows: a kill mid-claim wedges the tunnel
 export LFKT_BENCH_TOTAL_TIMEOUT=${LFKT_BENCH_TOTAL_TIMEOUT:-2700}
 
-# refuse a double launch of ANY suite generation (the charclass form
-# "run_chip_suite[.2]" silently failed to match this very script — two
-# suites contending for the single-session tunnel is the wedge scenario)
-if pgrep -f "run_chip_suite" | grep -v "^$$\$" | grep -qv pgrep; then
-  echo "refusing to start: an earlier chip suite is still running" >&2
-  exit 1
+# refuse a double launch (two suites contending for the single-session
+# tunnel is the wedge scenario).  A pidfile lock, NOT pgrep: command-line
+# matching caught launcher/waiter wrappers whose argv contains this
+# script's path and refused legitimate relaunches (observed 19:14).
+LOCK=/tmp/lfkt_chip_suite.lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+  oldpid=$(cat "$LOCK/pid" 2>/dev/null)
+  if [ -n "$oldpid" ] && [ -d "/proc/$oldpid" ]; then
+    echo "refusing to start: suite pid $oldpid still running" >&2
+    exit 1
+  fi
+  rm -rf "$LOCK"
+  mkdir "$LOCK" || exit 1
 fi
+echo $$ > "$LOCK/pid"
+trap 'rm -rf "$LOCK"' EXIT
 
 echo "=== probe gate ($(date +%T)) ===" >&2
 bash tools/tpu_probe.sh /tmp/tpu_probe_suite3.log
