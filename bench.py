@@ -378,6 +378,12 @@ def child_main() -> None:
 
     from llama_fastapi_k8s_gpu_tpu.utils.jaxcache import setup_compile_cache
 
+    # Default the persistent-cache location on the accelerator: the driver
+    # invokes `python bench.py` with a bare env, and without this it pays
+    # ~60 s of remote compiles inside its own watchdog budget even when a
+    # prior chip-suite run has already warmed the cache at this path.
+    if jax.default_backend() != "cpu":
+        os.environ.setdefault("LFKT_COMPILE_CACHE_DIR", "/tmp/lfkt_xla_cache")
     setup_compile_cache()
 
     from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B, ModelConfig
