@@ -180,12 +180,14 @@ def load_params(gf: GGUFFile, cfg: ModelConfig, fmt: str = "bf16",
     # moment its planes are packed, so the (async) transfers stream while
     # the C++ packers prep the NEXT layers, instead of serializing all
     # packing before all transfer (the default _stack(host arrays) order).
-    # The final stack then concatenates resident device arrays.  Off by
-    # default until the coldstart A/B lands (the phase split in
-    # coldstart_*.json decides whether transfer time is worth hiding).
+    # The final stack then concatenates resident device arrays.  Default ON
+    # since the 2026-08-01 coldstart A/B: 226.5 s -> 180.8 s load (the
+    # first request then absorbs ~19 s of still-draining transfers, net
+    # 245.8 -> 218.9 s to first token, -11% — coldstart_2026-08-01.json vs
+    # coldstart_overlap_2026-08-01.json).
     from ..utils.config import env_bool
 
-    overlap = env_bool("LFKT_LOAD_OVERLAP")
+    overlap = env_bool("LFKT_LOAD_OVERLAP", default=True)
 
     layers = []
     t0 = _time.time()

@@ -208,7 +208,15 @@ def _env_variant(name: str, allowed: tuple) -> str:
     return v
 
 
-Q4K_VARIANTS = ("cur", "resplit", "vbf32", "onedot")
+# Default (first) = resplit: bit-identical planes to `cur` via the exact
+# lsc = v*sc - 16*(h*sc) cancellation.  On-chip B=1 geomean 125.9 vs
+# cur's 126.8 us (ahead at (4096,4096) and (14336,4096), behind 0.3% at
+# (4096,14336) — kernel_microbench_2026-08-01) and +1.8% end-to-end
+# (72.32 vs 71.02 tok/s, bench_q4km_variant_ab vs bench_q4km_headline
+# 2026-08-01).  vbf32 is ~8% faster still but FAILS the on-chip numerics
+# gate (Mosaic truncates its f32 dot to single-pass bf16: rel_dev ~3e-2
+# — the microbench dev_fail rows); never default it.
+Q4K_VARIANTS = ("resplit", "cur", "vbf32", "onedot")
 
 
 def _lane_repeat(v, times: int, interpret: bool):

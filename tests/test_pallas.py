@@ -210,8 +210,10 @@ def test_load_params_on_device_matches_host(tmp_path, fmt):
 @pytest.mark.parametrize("fmt", ["bf16", "int8"])
 def test_load_params_overlap_matches_default(tmp_path, fmt, monkeypatch):
     """LFKT_LOAD_OVERLAP=1 (per-layer async device_put + device-side stack,
-    progressive freeing) must produce a bitwise-identical pytree to the
-    default host-side stack order."""
+    progressive freeing; the default since the 2026-08-01 coldstart A/B)
+    must produce a bitwise-identical pytree to the serial host-side stack
+    order (LFKT_LOAD_OVERLAP=0 — pinned explicitly so the serial path
+    keeps its only identity coverage whatever the shipped default)."""
     from llama_fastapi_k8s_gpu_tpu.gguf import GGUFFile
     from llama_fastapi_k8s_gpu_tpu.models.params import load_params
     from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
@@ -220,7 +222,7 @@ def test_load_params_overlap_matches_default(tmp_path, fmt, monkeypatch):
     cfg = write_tiny_llama_gguf(path, quant=GGMLType.Q4_K,
                                 ffn_quant=GGMLType.Q6_K)
     gf = GGUFFile(path)
-    monkeypatch.delenv("LFKT_LOAD_OVERLAP", raising=False)
+    monkeypatch.setenv("LFKT_LOAD_OVERLAP", "0")
     base = load_params(gf, cfg, fmt=fmt, on_device=False)
     monkeypatch.setenv("LFKT_LOAD_OVERLAP", "1")
     over = load_params(gf, cfg, fmt=fmt, on_device=False)

@@ -188,8 +188,8 @@ def test_parfloor_variant_bit_identical(monkeypatch):
     x = jnp.asarray(rng.standard_normal((4, k)), jnp.bfloat16)
     # the variant is part of the builder cache key, so flipping the env
     # between calls re-traces without any cache_clear choreography.
-    # Compare cur vs parfloor EXPLICITLY (parfloor is now the tuple
-    # default, so an unset env would compare parfloor with itself).
+    # Compare cur vs parfloor EXPLICITLY so the assertion is immune to
+    # which of the two bit-identical variants leads the tuple default.
     monkeypatch.setenv("LFKT_Q6K_KERNEL", "cur")
     a = np.asarray(q6k_matmul(x, wd, interpret=True))
     monkeypatch.setenv("LFKT_Q6K_KERNEL", "parfloor")

@@ -156,8 +156,11 @@ def test_q4k_params_shard_over_mesh():
 
 
 def test_resplit_variant_bit_identical(monkeypatch):
-    """LFKT_Q4K_KERNEL=resplit must produce BIT-identical output to the
-    default: its lsc = v*sc - 16*(h*sc) cancellation is exact in f32."""
+    """LFKT_Q4K_KERNEL=resplit (the shipped default since the 2026-08-01
+    chip A/B) must produce BIT-identical output to `cur`: its
+    lsc = v*sc - 16*(h*sc) cancellation is exact in f32.  Both sides pin
+    the variant explicitly so the assertion stays cur-vs-resplit whatever
+    the default ordering of Q4K_VARIANTS."""
     import numpy as np
 
     from llama_fastapi_k8s_gpu_tpu.gguf.quants import quant_q4_k
@@ -172,7 +175,7 @@ def test_resplit_variant_bit_identical(monkeypatch):
     x = jnp.asarray(rng.standard_normal((4, k)), jnp.bfloat16)
     # the variant is part of the builder cache key, so flipping the env
     # between calls re-traces without any cache_clear choreography
-    monkeypatch.delenv("LFKT_Q4K_KERNEL", raising=False)
+    monkeypatch.setenv("LFKT_Q4K_KERNEL", "cur")
     a = np.asarray(q4k_matmul(x, wd, interpret=True))
     monkeypatch.setenv("LFKT_Q4K_KERNEL", "resplit")
     b = np.asarray(q4k_matmul(x, wd, interpret=True))
@@ -192,7 +195,7 @@ def test_onedot_variant_matches_default(monkeypatch):
     w = (rng.standard_normal((n, k)) * 0.05).astype(np.float32)
     wd = prep_q4k(quant_q4_k(w.reshape(-1)), n, k)
     x = jnp.asarray(rng.standard_normal((4, k)), jnp.bfloat16)
-    monkeypatch.delenv("LFKT_Q4K_KERNEL", raising=False)
+    monkeypatch.setenv("LFKT_Q4K_KERNEL", "cur")
     a = np.asarray(q4k_matmul(x, wd, interpret=True))
     monkeypatch.setenv("LFKT_Q4K_KERNEL", "onedot")
     b = np.asarray(q4k_matmul(x, wd, interpret=True))
@@ -216,7 +219,7 @@ def test_vbf32_variant_beats_default_accuracy(monkeypatch):
     x = jnp.asarray(rng.standard_normal((4, k)), jnp.float32)
     ref = np.asarray(
         permute_x(x).astype(jnp.bfloat16).astype(jnp.float32) @ dequant_ref(wd).T)
-    monkeypatch.delenv("LFKT_Q4K_KERNEL", raising=False)
+    monkeypatch.setenv("LFKT_Q4K_KERNEL", "cur")
     cur = np.asarray(q4k_matmul(x, wd, interpret=True))
     monkeypatch.setenv("LFKT_Q4K_KERNEL", "vbf32")
     got = np.asarray(q4k_matmul(x, wd, interpret=True))

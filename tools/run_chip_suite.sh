@@ -18,8 +18,10 @@ step() {
   sleep 5
 }
 
-# 1) headline q4km grid, current kernel
-step bench_q4km_cur python bench.py
+# 1) headline q4km grid, `cur` kernel — pinned explicitly: resplit became
+#    the shipped default on 2026-08-01, so a bare `python bench.py` would
+#    silently turn steps 1-2 into resplit-vs-resplit
+step bench_q4km_cur env LFKT_Q4K_KERNEL=cur python bench.py
 # 2) restructured-kernel A/B (bit-identical math, shallower VPU graphs)
 step bench_q4km_resplit env LFKT_Q4K_KERNEL=resplit python bench.py
 step bench_q4km_resplit_parfloor env LFKT_Q4K_KERNEL=resplit LFKT_Q6K_KERNEL=parfloor python bench.py

@@ -100,7 +100,10 @@ fi
 # 4) cold start: pre-written file, load only, generous ceiling — then the
 #    transfer/pack-overlap arm (LFKT_LOAD_OVERLAP) as an in-suite A/B
 python tools/write_coldstart_gguf.py >&2 || true   # no-op if file exists
-step coldstart env LFKT_BENCH_COLDSTART=1 LFKT_COLDSTART_REUSE=1 python bench.py
+#    (overlap became the DEFAULT on 2026-08-01, so the serial control arm
+#    must pin it off — a bare run would A/B overlap against itself)
+step coldstart env LFKT_BENCH_COLDSTART=1 LFKT_COLDSTART_REUSE=1 \
+  LFKT_LOAD_OVERLAP=0 python bench.py
 step coldstart_overlap env LFKT_BENCH_COLDSTART=1 LFKT_COLDSTART_REUSE=1 \
   LFKT_LOAD_OVERLAP=1 python bench.py
 

@@ -25,7 +25,22 @@ import time
 
 OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "docs", "bench")
-DEFAULTS = {"q4k": "cur", "q5k": "cur", "q6k": "parfloor"}
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _defaults() -> dict:
+    """Shipped defaults ARE Q*_VARIANTS[0] (_env_variant's contract) —
+    derived, not hand-copied, so a future default flip can't desync the
+    picker into benching the default against itself."""
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q5matmul import Q5K_VARIANTS
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import Q6K_VARIANTS
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import Q4K_VARIANTS
+
+    return {"q4k": Q4K_VARIANTS[0], "q5k": Q5K_VARIANTS[0],
+            "q6k": Q6K_VARIANTS[0]}
+
+
+DEFAULTS = _defaults()
 KNOB = {"q4k": "LFKT_Q4K_KERNEL", "q5k": "LFKT_Q5K_KERNEL",
         "q6k": "LFKT_Q6K_KERNEL"}
 
