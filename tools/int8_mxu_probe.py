@@ -1,0 +1,41 @@
+"""Does int8xint8->int32 dot_general beat bf16xbf16->f32 at decode shapes
+on v5e?  If the MXU streams int8 weight tiles at ~2x the bf16 rate, a
+w8a8 mode roughly doubles weight-load-bound decode."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+
+ITERS = 1000
+SHAPES = [(14336, 4096), (4096, 4096), (4096, 14336)]
+
+def chain(dot, x, iters=ITERS):
+    @jax.jit
+    def run(x):
+        def body(x, _):
+            y = dot(x)
+            r = jnp.sum(y, axis=1, keepdims=True)
+            return (x + (r * 0).astype(x.dtype) + (r % 3).astype(x.dtype)), ()
+        x, _ = jax.lax.scan(body, x, None, length=iters)
+        return x
+    def sync(v): np.asarray(jax.device_get(v)).sum()
+    sync(run(x)); sync(run(x))
+    t0 = time.perf_counter(); sync(run(x))
+    return (time.perf_counter() - t0) / iters
+
+rng = np.random.default_rng(0)
+print("device:", jax.devices()[0])
+for (n, k) in SHAPES:
+    wb = jnp.asarray(rng.standard_normal((n, k)) * 0.02, jnp.bfloat16)
+    wi = jnp.asarray(rng.integers(-127, 127, (n, k)), jnp.int8)
+    for b in (1, 8):
+        xb = jnp.ones((b, k), jnp.bfloat16)
+        xi = jnp.ones((b, k), jnp.int8)
+        t_bf = chain(lambda x: jax.lax.dot_general(
+            x, wb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32), xb)
+        t_i8 = chain(lambda x: jax.lax.dot_general(
+            x, wi, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32), xi)
+        print(f"({n},{k}) B={b}: bf16 {t_bf*1e6:.1f} us, int8 {t_i8*1e6:.1f} us, "
+              f"ratio {t_bf/t_i8:.2f}x", flush=True)
