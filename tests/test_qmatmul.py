@@ -155,6 +155,22 @@ def test_q4k_params_shard_over_mesh():
     assert sharded["layers"]["wq"]["qs"].shape == params["layers"]["wq"]["qs"].shape
 
 
+def test_shipped_kernel_defaults_are_the_measured_configuration():
+    """The tuple heads are a MEASURED decision, not style: the 2026-08-01
+    chip A/B banked 72.32 tok/s with exactly q4k=resplit + q6k=cur
+    (docs/bench/bench_q4km_variant_ab_2026-08-01.json, confirmed bare-env
+    by bench_q4km_postflip_2026-08-01.json).  A reorder silently changes
+    the shipped default (_env_variant takes allowed[0]) and detaches the
+    headline claim from its artifact — flip only with a new banked A/B."""
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q5matmul import Q5K_VARIANTS
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import Q6K_VARIANTS
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.qmatmul import Q4K_VARIANTS
+
+    assert Q4K_VARIANTS[0] == "resplit"
+    assert Q6K_VARIANTS[0] == "cur"
+    assert Q5K_VARIANTS[0] == "cur"
+
+
 def test_resplit_variant_bit_identical(monkeypatch):
     """LFKT_Q4K_KERNEL=resplit (the shipped default since the 2026-08-01
     chip A/B) must produce BIT-identical output to `cur`: its
