@@ -441,7 +441,15 @@ class ContinuousEngine(MeshEngine):
             if reuse:
                 # snapshot the source lane's ring as this admission's
                 # scratch; the functional gather captures the lane BEFORE
-                # any later decode writes, so the claim region is stable
+                # any later decode writes, so the claim region is stable.
+                # Drop the old scratch FIRST: holding it across the copy
+                # peaks HBM one full lane-ring higher, which is what tipped
+                # the 8-lane 8B prefill arm into ResourceExhausted on 16 GB
+                # (suite3 2026-08-01).  If the copy itself fails, scratch
+                # stays None and _dispatch_prefill_chunk lazily re-creates
+                # it — allocating a replacement HERE, inside the failure,
+                # would be a second allocation on the same exhausted HBM.
+                self._scratch_cache = None
                 self._scratch_cache = _lane_cache_copy_jit(
                     self._bstate["cache"], jnp.int32(src))
                 # stats are counted in _finish_admission: an item abandoned
@@ -464,6 +472,12 @@ class ContinuousEngine(MeshEngine):
     def _dispatch_prefill_chunk(self, adm: dict) -> None:
         """Run ONE prompt slice through the model into the scratch cache.
         Keeps the logits of the slice containing the last real token."""
+        if self._scratch_cache is None:
+            # a failed lane snapshot (_begin_admission reuse path) dropped
+            # the scratch; re-create it now that the failing allocation is
+            # gone.  Prefill needs no zeroing: positions past the prompt
+            # are never attended.
+            self._scratch_cache = init_cache(self.cfg)
         off = adm["offset"]
         C = min(self._prefill_chunk, adm["bucket"] - off)
         sl = jnp.asarray(adm["padded"][off:off + C], jnp.int32)

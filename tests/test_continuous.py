@@ -605,3 +605,16 @@ def test_lane_prefix_reuse_on_sharded_mesh(tmp_path):
         assert t2["choices"][0]["message"]["content"]
     finally:
         eng.shutdown()
+
+
+def test_scratch_none_recovers(cengine):
+    """A failed lane snapshot leaves _scratch_cache = None (the reuse path
+    frees the old scratch BEFORE the copy so HBM never holds two rings —
+    the 8-lane 16 GB OOM fix).  The next admission must lazily re-create
+    it rather than crash the scheduler loop engine-wide."""
+    cengine._scratch_cache = None
+    out = cengine.create_chat_completion(
+        [{"role": "user", "content": "recover please"}],
+        temperature=0.0, max_tokens=4)
+    assert out["usage"]["completion_tokens"] >= 1
+    assert cengine._scratch_cache is not None
