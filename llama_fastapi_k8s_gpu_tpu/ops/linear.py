@@ -102,7 +102,7 @@ def _fused_fns(w: dict):
         from .pallas import qmatmul as m
 
         return m.q4k_matmul, m.q4k_matmul_stacked
-    if "q4" in w:
+    if "q4" in w or "q6p" in w:   # split or `pre` Q6_K layout
         from .pallas import q6matmul as m
 
         return m.q6k_matmul, m.q6k_matmul_stacked

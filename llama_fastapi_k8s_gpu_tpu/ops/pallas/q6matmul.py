@@ -74,7 +74,20 @@ from .qmatmul import (
 # claim) ran LFKT_Q4K_KERNEL=resplit + LFKT_Q6K_KERNEL=cur — the default
 # tuple ships exactly the measured configuration (and the warm compile
 # cache the driver bench inherits).
-Q6K_VARIANTS = ("cur", "parfloor", "vbf32")
+#
+# `pre` is a LAYOUT variant (the others only re-order the kernel body):
+# prep stores one pre-combined int8 plane ``q6p = q6 ∈ [0,64)`` (N, K) at
+# 1 B/weight instead of the packed q4+q2 split at 0.75 B/weight.  The
+# kernel then pays ~3 VPU ops/weight (convert, ·eff, bf16 cast) instead
+# of ~7 (nibble+crumb extraction and recombination) — attacking the
+# measured 200 vs 147 µs gap to the Q4_K kernel at equal MXU tile count
+# (kernel_microbench_2026-08-01; the q4km mix carries ~32% of its
+# weights in Q6_K).  Numerics: ``q6·eff`` is an exact f32 product (6-bit
+# int × bf16 ≤ 14 mantissa bits), so the bf16-cast plane equals the
+# split path's plane; only the +8 hi-nibble bias moves from a separately
+# bf16-rounded corr column into the exact plane — deviation vs `cur` is
+# corr-rounding scale (~1e-3), same class as `onedot`, gated on chip.
+Q6K_VARIANTS = ("cur", "parfloor", "vbf32", "pre")
 
 _SUBS6 = TK // 16    # 128 sub-blocks of 16 per k-tile
 TKA6 = TK + 256      # + [xsum_all(128) | xsum_hi(128)] correction columns
@@ -87,9 +100,29 @@ q6k_compatible = q4k_compatible  # same divisibility classes
 # host-side weight prep
 # ---------------------------------------------------------------------------
 
+def _combine_q6p(q4: np.ndarray, q2: np.ndarray, n_out: int,
+                 k_in: int) -> np.ndarray:
+    """Split planes → the `pre` layout's combined plane ``q6p`` (N, K) int8,
+    true ``q6 = nib | crumb<<4`` ∈ [0, 64) in element-major tile-column
+    order.  Tile-local column ``c``: nibble from q4 byte ``c % 1024``
+    (lo if c < 1024 else hi), crumb from q2 byte ``c % 512`` (digit
+    ``c // 512``).  Pure integer numpy over the native packers' output —
+    the C++ layout contract is untouched."""
+    kt = k_in // TK
+    v4 = q4.reshape(n_out, kt, TK // 2)
+    lo = (v4 & 0x0F).astype(np.int8)                  # low nibble
+    hi = ((v4 >> 4) + 8).astype(np.int8)              # true high nibble
+    nib = np.concatenate([lo, hi], axis=2)            # (N, kt, TK)
+    u = q2.reshape(n_out, kt, TK // 4).astype(np.int16) + 128  # ∈ [0,255]
+    crumb = np.concatenate(
+        [u & 3, (u >> 2) & 3, (u >> 4) & 3, (u >> 6) & 3], axis=2)
+    return (nib + (crumb << 4).astype(np.int8)).reshape(n_out, k_in)
+
+
 def prep_q6k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
     """Raw Q6_K block bytes (row-major, ``n_out`` rows of ``k_in`` elements)
-    → the kernel layout dict {"q4", "q2", "sm6"}.
+    → the kernel layout dict: {"q4", "q2", "sm6"} (split layout) or
+    {"q6p", "sm6"} under ``LFKT_Q6K_KERNEL=pre`` (see Q6K_VARIANTS).
 
     Dispatches to the threaded C++ packer (native/src/gguf_dequant.cpp,
     bit-identical planes — tests/test_native.py) when available; the numpy
@@ -99,8 +132,14 @@ def prep_q6k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
                          f"(need K%{TK}==0, N%128==0)")
     from ...native import native_prep_q6k
 
+    pre = _env_variant("LFKT_Q6K_KERNEL", Q6K_VARIANTS) == "pre"
     nat = native_prep_q6k(raw, n_out, k_in)
     if nat is not None:
+        if pre:
+            return {"q6p": jnp.asarray(_combine_q6p(
+                        np.asarray(nat["q4"]), np.asarray(nat["q2"]),
+                        n_out, k_in)),
+                    "sm6": jnp.asarray(nat["sm6"])}
         return {"q4": jnp.asarray(nat["q4"]), "q2": jnp.asarray(nat["q2"]),
                 "sm6": jnp.asarray(nat["sm6"])}
     bs = GGML_BLOCK_SIZES[GGMLType.Q6_K][1]           # 210
@@ -141,11 +180,11 @@ def prep_q6k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
 
     eff = d[..., None] * sc                           # (N, nb, 16)
     sm6 = eff.reshape(n_out, kt, _SUBS6).transpose(1, 0, 2)
-    return {
-        "q4": jnp.asarray(q4),
-        "q2": jnp.asarray(q2),
-        "sm6": jnp.asarray(np.ascontiguousarray(sm6), dtype=jnp.bfloat16),
-    }
+    sm6 = jnp.asarray(np.ascontiguousarray(sm6), dtype=jnp.bfloat16)
+    if pre:
+        return {"q6p": jnp.asarray(_combine_q6p(q4, q2, n_out, k_in)),
+                "sm6": sm6}
+    return {"q4": jnp.asarray(q4), "q2": jnp.asarray(q2), "sm6": sm6}
 
 
 def permute_x6(x: jax.Array) -> jax.Array:
@@ -301,6 +340,44 @@ def _q6k_vbf32_body(xpa_ref, v4, h, u, sm, corr, o_ref, interpret):
     _q4k_accum(o_ref, part)
 
 
+def _q6k_pre_kernel(xpa_ref, q6p_ref, sm_ref, o_ref, *, interpret):
+    """`pre` layout body: one combined int8 plane, ~3 VPU ops/weight.
+
+    ``y = Σ x·(q6−32)·eff = dot(x, q6·eff) − 32·Σ_s eff_s·xsum_s`` — the
+    hi-nibble bias lives inside the exact plane, so only the −32 offset
+    rides the correction dot; the xsum_hi half of the shared augment_x6
+    columns is dotted against zeros (keeping one activation layout for
+    both Q6_K layouts costs 128 dead columns ≈ 6% of the corr dot, which
+    is itself ~6% of the MXU work)."""
+    TN = q6p_ref.shape[0]
+    sm = sm_ref[...].reshape(TN, 128)
+    eff = _lane_repeat(sm, TK // 128, interpret)
+    a = (q6p_ref[...].astype(jnp.float32) * eff).astype(jnp.bfloat16)
+    corr = jnp.concatenate(
+        [sm * -32.0, jnp.zeros_like(sm)], axis=1).astype(jnp.bfloat16)
+    xpa = xpa_ref[...]
+    part = jax.lax.dot_general(
+        xpa[:, :TK], a, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    part += jax.lax.dot_general(
+        xpa[:, TK:], corr, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    _q4k_accum(o_ref, part)
+
+
+def _q6k_pre_specs(B: int, TN: int):
+    """(in_specs, out_spec) for the `pre` layout: one (TN, TK) int8 plane
+    plus the shared sm6 scale plane."""
+    return (
+        [
+            ((B, TKA6), lambda n, k: (0, k)),
+            ((TN, TK), lambda n, k: (n, k)),
+            ((1, TN, 128), lambda n, k: (k, n, 0)),
+        ],
+        ((B, TN), lambda n, k: (0, n)),
+    )
+
+
 _TN_PREFS_Q6K = (256, 128)  # wider f32 intermediates than Q4_K: smaller TN
 
 
@@ -331,6 +408,83 @@ def _q6k_2d_raw(xpa: jax.Array, q4: jax.Array, q2: jax.Array, sm: jax.Array,
         (N // TN, K // TK), in_specs, out_spec,
         jax.ShapeDtypeStruct((B, N), jnp.float32), interpret,
     )(xpa, q4, q2, sm)
+
+
+def _q6k_pre_2d_raw(xpa: jax.Array, q6p: jax.Array, sm: jax.Array,
+                    interpret: bool) -> jax.Array:
+    B, KA = xpa.shape
+    K = (KA // TKA6) * TK
+    N = q6p.shape[0]
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q6K))
+    in_specs, out_spec = _q6k_pre_specs(B, TN)
+    return plain_pallas_call(
+        functools.partial(_q6k_pre_kernel, interpret=interpret),
+        (N // TN, K // TK), in_specs, out_spec,
+        jax.ShapeDtypeStruct((B, N), jnp.float32), interpret,
+    )(xpa, q6p, sm)
+
+
+@functools.lru_cache(maxsize=4)
+def _q6k_pre_2d_partitioned(interpret: bool):
+    """GSPMD rule for the `pre` layout (same contract: partition N/rows,
+    never K)."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @custom_partitioning
+    def fn(xpa, q6p, sm):
+        return _q6k_pre_2d_raw(xpa, q6p, sm, interpret)
+
+    def partition(mesh, arg_shapes, result_shape):
+        rows = _spec_axis(arg_shapes[0].sharding, 0)
+        n_ax = _spec_axis(arg_shapes[1].sharding, 0)
+        arg_shardings = (
+            NamedSharding(mesh, P(rows, None)),
+            NamedSharding(mesh, P(n_ax, None)),
+            NamedSharding(mesh, P(None, n_ax, None)),
+        )
+
+        def lower(xpa, q6p, sm):
+            return _q6k_pre_2d_raw(xpa, q6p, sm, interpret)
+
+        return (mesh, lower, NamedSharding(mesh, P(rows, n_ax)),
+                arg_shardings)
+
+    def infer(mesh, arg_shapes, result_shape):
+        return NamedSharding(
+            mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
+                    _spec_axis(arg_shapes[1].sharding, 0)))
+
+    fn.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule="b k, n j, t n l -> b n",
+    )
+    return jax.jit(rows_vmappable(fn, xpa_pos=0))
+
+
+def _q6k_pre_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, q6p: jax.Array,
+                            sm: jax.Array, interpret: bool) -> jax.Array:
+    B, KA = xpa.shape
+    K = (KA // TKA6) * TK
+    N = q6p.shape[1]
+    TN = _pick_tn(N, interpret, prefs=_tn_prefs_for(B, _TN_PREFS_Q6K))
+    in_specs, out_spec = _q6k_pre_specs(B, TN)
+    call = stacked_pallas_call(
+        functools.partial(_q6k_pre_kernel, interpret=interpret),
+        grid=(N // TN, K // TK),
+        in_specs=in_specs,
+        out_spec=out_spec,
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
+        interpret=interpret,
+    )
+    return call(idx, xpa, q6p, sm)
+
+
+@functools.lru_cache(maxsize=4)
+def _q6k_pre_2d_stacked_partitioned(interpret: bool):
+    return stacked_partitioned(
+        _q6k_pre_2d_stacked_raw, "i, b k, l n j, l t n m -> b n", interpret)
 
 
 @functools.lru_cache(maxsize=8)
@@ -404,27 +558,44 @@ def _q6k_2d_stacked_partitioned(interpret: bool, variant: str = "cur"):
 def q6k_matmul_stacked(x: jax.Array, w: dict, idx,
                        interpret: bool | None = None) -> jax.Array:
     """x (..., K) → (..., N) against layer ``idx`` of stacked Q6_K weights
-    (``q4`` (L, N, K/2), ``q2`` (L, N, K/4), ``sm6`` (L, K/2048, N, 128))."""
+    (``q4`` (L, N, K/2), ``q2`` (L, N, K/4), ``sm6`` (L, K/2048, N, 128);
+    or ``q6p`` (L, N, K) + ``sm6`` for the `pre` layout).  The program is
+    dispatched on the LAYOUT (plane presence), not the env knob, so
+    weights prepped under one variant can never meet the other family's
+    kernel."""
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x6(permute_x6(x).reshape(-1, K).astype(jnp.bfloat16))
-    fn = _q6k_2d_stacked_partitioned(
-        _interpret(interpret),
-        _env_variant("LFKT_Q6K_KERNEL", Q6K_VARIANTS))
     i1 = jnp.asarray(idx, jnp.int32).reshape(1)
-    y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws),
-                     xpa, w["q4"], w["q2"], w["sm6"])
+    if "q6p" in w:
+        fn = _q6k_pre_2d_stacked_partitioned(_interpret(interpret))
+        y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws),
+                         xpa, w["q6p"], w["sm6"])
+    else:
+        var = _env_variant("LFKT_Q6K_KERNEL", Q6K_VARIANTS)
+        fn = _q6k_2d_stacked_partitioned(
+            _interpret(interpret), "cur" if var == "pre" else var)
+        y = batched_rows(lambda xp, *ws: fn(i1, xp, *ws),
+                         xpa, w["q4"], w["q2"], w["sm6"])
     return y.reshape(*lead, -1).astype(x.dtype)
 
 
 def q6k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array:
     """x (..., K) bf16/f32 → (..., N) in x.dtype, weights in Q6_K kernel
-    layout.  The fused path of ``ops.linear.linear`` for Q6_K tensors."""
+    layout.  The fused path of ``ops.linear.linear`` for Q6_K tensors.
+    Layout-dispatched like :func:`q6k_matmul_stacked`."""
     K = x.shape[-1]
     lead = x.shape[:-1]
     xpa = augment_x6(permute_x6(x).reshape(-1, K).astype(jnp.bfloat16))
-    fn = _q6k_2d_partitioned(
-        _interpret(interpret),
-        _env_variant("LFKT_Q6K_KERNEL", Q6K_VARIANTS))
-    y = batched_rows(fn, xpa, w["q4"], w["q2"], w["sm6"])
+    if "q6p" in w:
+        fn = _q6k_pre_2d_partitioned(_interpret(interpret))
+        y = batched_rows(fn, xpa, w["q6p"], w["sm6"])
+    else:
+        # `pre` is a layout variant: split-layout weights (e.g. prepped
+        # before the env flip) run the split default, never a silent
+        # mislabel
+        var = _env_variant("LFKT_Q6K_KERNEL", Q6K_VARIANTS)
+        fn = _q6k_2d_partitioned(
+            _interpret(interpret), "cur" if var == "pre" else var)
+        y = batched_rows(fn, xpa, w["q4"], w["q2"], w["sm6"])
     return y.reshape(*lead, -1).astype(x.dtype)

@@ -70,6 +70,7 @@ def _linear_sharding(mesh: Mesh, col_parallel: bool) -> dict:
         "sm5": _ns(mesh, None, None, "tp", None),
         "q4": _ns(mesh, None, "tp", None),
         "q2": _ns(mesh, None, "tp", None),
+        "q6p": _ns(mesh, None, "tp", None),
         "sm6": _ns(mesh, None, None, "tp", None),
         "q8": _ns(mesh, None, "tp", None),
         "sm8": _ns(mesh, None, None, "tp", None),
@@ -109,6 +110,7 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
             "q5s": _ns(mesh, "tp", None), "q5h": _ns(mesh, "tp", None),
             "sm5": _ns(mesh, None, "tp", None),
             "q4": _ns(mesh, "tp", None), "q2": _ns(mesh, "tp", None),
+            "q6p": _ns(mesh, "tp", None),
             "sm6": _ns(mesh, None, "tp", None),
             "q8": _ns(mesh, "tp", None),
             "sm8": _ns(mesh, None, "tp", None)}
@@ -166,7 +168,10 @@ def _fit_sharding(arr, ns: NamedSharding) -> NamedSharding:
     return NamedSharding(mesh, P(*fixed))
 
 
-_FUSED_MAIN_KEY = {"qs": "qs", "q4": "q4", "q5s": "q5s", "q8": "q8"}  # layout → main leaf
+# layout → main leaf (the plane whose N dim decides the whole group's fit);
+# "q6p" is the Q6_K `pre` layout's single combined plane
+_FUSED_MAIN_KEY = {"qs": "qs", "q4": "q4", "q6p": "q6p",
+                   "q5s": "q5s", "q8": "q8"}
 
 
 def _fused_key(p: dict) -> str | None:

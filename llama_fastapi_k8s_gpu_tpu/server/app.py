@@ -558,8 +558,8 @@ def create_app(engine=None, settings: Settings | None = None,
             params = getattr(eng, "params", None)
             if isinstance(params, dict) and "layers" in params:
                 kinds = {"qs": "q4k-fused", "q5s": "q5k-fused",
-                         "q4": "q6k-fused", "q8": "q8-fused",
-                         "q": "int8", "w": "bf16"}
+                         "q4": "q6k-fused", "q6p": "q6k-fused-pre",
+                         "q8": "q8-fused", "q": "int8", "w": "bf16"}
                 fmt = {
                     name: next((v for k, v in kinds.items() if k in leaf), "?")
                     for name, leaf in params["layers"].items()

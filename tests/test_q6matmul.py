@@ -221,3 +221,91 @@ def test_vbf32_variant_beats_default_accuracy(monkeypatch):
     assert err_vb <= err_cur * 1.05, (err_vb, err_cur)
     np.testing.assert_allclose(got, ref, rtol=2e-2,
                                atol=2e-2 * float(np.abs(ref).max()))
+
+
+def test_pre_layout_matches_oracle_and_split(monkeypatch):
+    """LFKT_Q6K_KERNEL=pre (pre-combined int8 q6 plane, ~3 VPU ops/weight)
+    must agree with the f32 dequant oracle at least as tightly as the
+    split `cur` path: its plane q6*eff is the exact f32 value the split
+    path reaches via nib*eff + crumb*(16 eff) before the same bf16 cast,
+    and it ROUNDS ONE FEWER corr term (the +8 hi-nibble bias rides the
+    exact plane instead of a bf16 corr column)."""
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas import q6matmul as qm
+
+    rng = np.random.default_rng(11)
+    n, k = 64, 4096
+    raw = quant_q6_k(_rand_weights(rng, n, k).reshape(-1))
+    monkeypatch.setenv("LFKT_Q6K_KERNEL", "cur")
+    w_split = prep_q6k(raw, n, k)
+    monkeypatch.setenv("LFKT_Q6K_KERNEL", "pre")
+    w_pre = prep_q6k(raw, n, k)
+    assert set(w_pre) == {"q6p", "sm6"}
+    assert w_pre["q6p"].dtype == jnp.int8
+    q6p = np.asarray(w_pre["q6p"])
+    assert q6p.min() >= 0 and q6p.max() < 64
+
+    x = jnp.asarray(rng.standard_normal((4, k)), jnp.float32)
+    ref = np.asarray(
+        permute_x6(x).astype(jnp.bfloat16).astype(jnp.float32)
+        @ dequant_ref6(w_split).T)
+    got_pre = np.asarray(q6k_matmul(x, w_pre, interpret=True))
+    monkeypatch.setenv("LFKT_Q6K_KERNEL", "cur")
+    got_cur = np.asarray(q6k_matmul(x, w_split, interpret=True))
+
+    scale = np.abs(ref).max()
+    err_pre = np.abs(got_pre - ref).max()
+    err_cur = np.abs(got_cur - ref).max()
+    # pre rounds a strict subset of cur's terms; allow bf16-noise slack
+    assert err_pre <= err_cur + 2e-3 * scale, (err_pre, err_cur, scale)
+    np.testing.assert_allclose(got_pre, got_cur, atol=4e-3 * scale)
+
+
+def test_pre_layout_stacked_matches_plain(monkeypatch):
+    """Stacked scalar-prefetch path == plain path for the pre layout."""
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q6matmul import (
+        q6k_matmul_stacked,
+    )
+
+    rng = np.random.default_rng(12)
+    n, k = 32, 2048
+    monkeypatch.setenv("LFKT_Q6K_KERNEL", "pre")
+    w0 = prep_q6k(quant_q6_k(_rand_weights(rng, n, k).reshape(-1)), n, k)
+    w1 = prep_q6k(quant_q6_k(_rand_weights(rng, n, k).reshape(-1)), n, k)
+    ws = {key: jnp.stack([w0[key], w1[key]]) for key in w0}
+    x = jnp.asarray(rng.standard_normal((2, k)), jnp.bfloat16)
+    for i, w in enumerate((w0, w1)):
+        plain = np.asarray(q6k_matmul(x, w, interpret=True))
+        stacked = np.asarray(q6k_matmul_stacked(x, ws, i, interpret=True))
+        np.testing.assert_array_equal(plain, stacked)
+
+
+def test_pre_layout_shards_on_mesh(monkeypatch):
+    """The q6p plane must ride the full shard_params path: tp over N when
+    the per-shard N keeps the kernel tiling, and — the fused-GROUP guard
+    (`_FUSED_MAIN_KEY`) — whole-leaf replication when it would not (the
+    Llama-3 output head's 128256/tp=4 = 32064 is not 128-aligned; in
+    interpret mode the granularity is 8, so N=24 over tp=2 → 12 models
+    the same violation)."""
+    from llama_fastapi_k8s_gpu_tpu.parallel.mesh import (
+        make_mesh, param_shardings, shard_params,
+    )
+
+    rng = np.random.default_rng(13)
+    monkeypatch.setenv("LFKT_Q6K_KERNEL", "pre")
+    n, k = 256, 2048
+    w = prep_q6k(quant_q6_k(_rand_weights(rng, n, k).reshape(-1)), n, k)
+    ws = {key: jnp.stack([w[key], w[key]]) for key in w}
+    n_bad = 24                      # 24/tp=12, not a multiple of gran=8
+    w_bad = prep_q6k(
+        quant_q6_k(_rand_weights(rng, n_bad, k).reshape(-1)), n_bad, k)
+    params = {"tok_emb": jnp.zeros((8, 8)), "out_norm": jnp.zeros((8,)),
+              "layers": {"w_down": ws, "attn_norm": jnp.zeros((2, 8))},
+              "output": w_bad}
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    sh = param_shardings(params, mesh)
+    assert sh["layers"]["w_down"]["q6p"] is not None
+    sharded = shard_params(params, mesh)
+    assert sharded["layers"]["w_down"]["q6p"].shape == ws["q6p"].shape
+    # the ill-fitting head leaf must come back REPLICATED, not half-sharded
+    head_spec = sharded["output"]["q6p"].sharding.spec
+    assert all(a is None for a in head_spec), head_spec

@@ -60,12 +60,16 @@ KNOB = {"q4k": "LFKT_Q4K_KERNEL", "q5k": "LFKT_Q5K_KERNEL",
         "q6k": "LFKT_Q6K_KERNEL"}
 
 
-def weight_bytes(fmt: str, n: int, k: int) -> int:
-    """HBM bytes one matvec must read (weights; activations negligible)."""
+def weight_bytes(fmt: str, n: int, k: int, variant: str = "") -> int:
+    """HBM bytes one matvec must read (weights; activations negligible).
+    ``variant`` matters for LAYOUT variants: q6k `pre` stores one combined
+    int8 plane (1 B/weight) instead of the 0.75 B/weight split."""
     if fmt == "q4k":                       # qs N*K/2 + sm (K/2048)*N*128*2
         return n * k // 2 + (k // 2048) * n * 128 * 2
     if fmt == "q5k":                       # q4 plane + hi-bit plane + sm
         return n * k // 2 + n * k // 8 + (k // 2048) * n * 128 * 2
+    if fmt == "q6k" and variant == "pre":  # combined plane + bf16 scales/16
+        return n * k + (k // 16) * n * 2
     if fmt == "q6k":                       # 6 bit/w planes + bf16 scales/16
         return n * k * 3 // 4 + (k // 16) * n * 2
     if fmt == "q8":                        # int8 + bf16 scale per 32
@@ -75,18 +79,22 @@ def weight_bytes(fmt: str, n: int, k: int) -> int:
     raise ValueError(fmt)
 
 
-def make_weight(fmt: str, n: int, k: int, rng) -> dict:
+def make_weight(fmt: str, wf: np.ndarray) -> dict:
+    """Build the fused layout for float weights ``wf``.  Called per
+    (fmt, variant) cell AFTER the variant env knob is set: `pre`-class
+    variants change the PREP layout, so prepping once per shape would
+    silently time the split kernel under the pre label.  The float array
+    is shared across variants so the numerics cross-check stays valid."""
     import importlib
 
     # ops/__init__ re-exports the `linear` FUNCTION under the submodule's
     # name, so plain attribute imports resolve to the function
     L = importlib.import_module("llama_fastapi_k8s_gpu_tpu.ops.linear")
 
-    w = (rng.standard_normal((n, k)).astype(np.float32) * (k ** -0.5))
     mk = {"q4k": L.make_linear_q4k, "q5k": L.make_linear_q5k,
           "q6k": L.make_linear_q6k, "q8": L.make_linear_q8,
           "int8": L.make_linear_int8}[fmt]
-    return jax.device_put(mk(w))
+    return jax.device_put(mk(wf))
 
 
 def timed_chain(linear_fn, w, b: int, k: int, n: int, iters: int) -> float:
@@ -138,15 +146,18 @@ def main() -> None:
     fmts = [f for f in VARIANTS if f in sel]
     for fmt in fmts:
         for (n, k) in SHAPES:
-            w = make_weight(fmt, n, k, rng)
-            # bytes / (GB/s · 1e3) = bytes/s · 1e-9 · 1e6 = microseconds
-            roof_us = weight_bytes(fmt, n, k) / (HBM_GBPS * 1e3)
+            wf = (rng.standard_normal((n, k)).astype(np.float32)
+                  * (k ** -0.5))
+            # roof_us = bytes / (GB/s · 1e3): set per-variant below (the
+            # q6k `pre` layout reads different bytes than the split)
             xprobe = jnp.asarray(
                 rng.standard_normal((8, k)) * 0.5, jnp.bfloat16)
             yref = ref_var = None
             for var in VARIANTS[fmt]:
                 if fmt in KNOB:
                     os.environ[KNOB[fmt]] = var
+                w = make_weight(fmt, wf)   # after the env: layout variants
+                roof_us = weight_bytes(fmt, n, k, var) / (HBM_GBPS * 1e3)
                 # on-chip numerics cross-check vs the reference variant
                 # (named in dev_ref; normally the default) — catches
                 # toolchain-specific plane truncation (e.g. an f32 dot
@@ -199,9 +210,9 @@ def main() -> None:
                           f"{dt*1e6:.1f} us ({100*roof_us/(dt*1e6):.0f}% "
                           f"roof, dev {rel_dev} vs {ref_var})",
                           file=sys.stderr, flush=True)
+                del w              # free this variant's planes before the next
                 if fmt in KNOB:
                     del os.environ[KNOB[fmt]]
-            del w
     out["rows"] = rows
     print(json.dumps(out), flush=True)
 
