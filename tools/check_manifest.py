@@ -1,0 +1,61 @@
+"""Evidence-ledger integrity check (VERDICT r4 #9's standing guard).
+
+Verifies, without touching any device:
+  1. every artifact named in docs/bench/MANIFEST.md exists and parses as
+     JSON (non-empty);
+  2. every `*_20??-??-??.json` cited in docs/PERF.md exists in docs/bench/;
+  3. every JSON in docs/bench/ has a MANIFEST row (no orphan evidence);
+  4. no 0-byte or `_tmp.*` files are tracked.
+
+Exit 0 clean; exit 1 with a line per violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(ROOT, "docs", "bench")
+
+
+def main() -> int:
+    bad = []
+    manifest = open(os.path.join(BENCH, "MANIFEST.md")).read()
+    rows = set(re.findall(r"`([\w.\-]+\.json)`", manifest))
+    perf = open(os.path.join(ROOT, "docs", "PERF.md")).read()
+    cited = set(re.findall(r"`([\w.\-]+_20\d\d-\d\d-\d\d[\w.\-]*\.json)`",
+                           perf))
+    on_disk = {f for f in os.listdir(BENCH) if f.endswith(".json")}
+
+    for f in sorted(rows):
+        p = os.path.join(BENCH, f)
+        if not os.path.exists(p):
+            bad.append(f"MANIFEST row has no file: {f}")
+            continue
+        try:
+            json.load(open(p))
+        except Exception as e:  # noqa: BLE001
+            bad.append(f"unparseable artifact: {f} ({e})")
+    for f in sorted(cited - rows):
+        bad.append(f"PERF.md cites artifact missing from MANIFEST: {f}")
+    for f in sorted(cited - on_disk):
+        bad.append(f"PERF.md cites nonexistent artifact: {f}")
+    for f in sorted(on_disk - rows):
+        bad.append(f"artifact on disk with no MANIFEST row: {f}")
+    for f in sorted(on_disk):
+        if f.startswith("_tmp.") or os.path.getsize(
+                os.path.join(BENCH, f)) == 0:
+            bad.append(f"scratch/0-byte file present: {f}")
+
+    for line in bad:
+        print(line)
+    print(f"{'FAIL' if bad else 'OK'}: {len(rows)} manifest rows, "
+          f"{len(cited)} PERF citations, {len(on_disk)} artifacts on disk")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
