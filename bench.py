@@ -381,9 +381,16 @@ def child_main() -> None:
     # Default the persistent-cache location on the accelerator: the driver
     # invokes `python bench.py` with a bare env, and without this it pays
     # ~60 s of remote compiles inside its own watchdog budget even when a
-    # prior chip-suite run has already warmed the cache at this path.
+    # prior chip-suite run has already warmed the cache.  Repo-local (not
+    # /tmp) so the warm state survives container restarts, which clear /tmp
+    # — a restart mid-round previously cost the next bare run ~66 s of
+    # recompiles plus a ~250 s cold synth-load path.
     if jax.default_backend() != "cpu":
-        os.environ.setdefault("LFKT_COMPILE_CACHE_DIR", "/tmp/lfkt_xla_cache")
+        os.environ.setdefault(
+            "LFKT_COMPILE_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".lfkt_xla_cache"),
+        )
     setup_compile_cache()
 
     from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B, ModelConfig

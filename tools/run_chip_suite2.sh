@@ -20,7 +20,7 @@ cd "$(dirname "$0")/.."
 TS=$(date +%F)
 OUT=docs/bench
 mkdir -p "$OUT"
-export LFKT_COMPILE_CACHE_DIR=${LFKT_COMPILE_CACHE_DIR:-/tmp/lfkt_xla_cache}
+export LFKT_COMPILE_CACHE_DIR=${LFKT_COMPILE_CACHE_DIR:-$(pwd)/.lfkt_xla_cache}
 
 if pgrep -f "run_chip_suite.sh" | grep -v $$ | grep -qv pgrep; then
   echo "refusing to start: run_chip_suite.sh still running" >&2

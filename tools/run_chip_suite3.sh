@@ -21,7 +21,7 @@ cd "$(dirname "$0")/.."
 TS=$(date +%F)
 OUT=docs/bench
 mkdir -p "$OUT"
-export LFKT_COMPILE_CACHE_DIR=${LFKT_COMPILE_CACHE_DIR:-/tmp/lfkt_xla_cache}
+export LFKT_COMPILE_CACHE_DIR=${LFKT_COMPILE_CACHE_DIR:-$(pwd)/.lfkt_xla_cache}
 # fewer, longer watchdog windows: a kill mid-claim wedges the tunnel
 export LFKT_BENCH_TOTAL_TIMEOUT=${LFKT_BENCH_TOTAL_TIMEOUT:-2700}
 
