@@ -386,11 +386,29 @@ def child_main() -> None:
     # — a restart mid-round previously cost the next bare run ~66 s of
     # recompiles plus a ~250 s cold synth-load path.
     if jax.default_backend() != "cpu":
-        os.environ.setdefault(
-            "LFKT_COMPILE_CACHE_DIR",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".lfkt_xla_cache"),
-        )
+        repo = os.path.dirname(os.path.abspath(__file__))
+        cache_dir = os.environ.setdefault(
+            "LFKT_COMPILE_CACHE_DIR", os.path.join(repo, ".lfkt_xla_cache"))
+        # Container restarts can reset the repo to its git state, deleting
+        # the (ignored) warm cache dir.  Entries restored IN PLACE at the
+        # same path still hit (measured: compile_s 4.8 after rm -rf +
+        # tar-restore; cross-dir copies miss — the key is path-scoped), so
+        # a committed seed tarball keeps a bare post-restart `python
+        # bench.py` warm.  Never clobbers a live cache; a stale seed just
+        # misses and recompiles.
+        seed = os.path.join(repo, "tools", "xla_cache_seed.tgz")
+        if (os.path.realpath(cache_dir)
+                == os.path.realpath(os.path.join(repo, ".lfkt_xla_cache"))
+                and not os.path.isdir(cache_dir) and os.path.exists(seed)):
+            import tarfile
+            try:
+                with tarfile.open(seed) as tf:
+                    tf.extractall(repo, filter="data")
+                print(f"bench: seeded compile cache from {seed}",
+                      file=sys.stderr, flush=True)
+            except Exception as e:  # seed is insurance, never a hard dep
+                print(f"bench: cache seed extract failed: {e}",
+                      file=sys.stderr, flush=True)
     setup_compile_cache()
 
     from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B, ModelConfig
