@@ -403,7 +403,10 @@ def child_main() -> None:
             import tarfile
             try:
                 with tarfile.open(seed) as tf:
-                    tf.extractall(repo, filter="data")
+                    members = [m for m in tf.getmembers()
+                               if m.name == ".lfkt_xla_cache"
+                               or m.name.startswith(".lfkt_xla_cache/")]
+                    tf.extractall(repo, members=members, filter="data")
                 print(f"bench: seeded compile cache from {seed}",
                       file=sys.stderr, flush=True)
             except Exception as e:  # seed is insurance, never a hard dep

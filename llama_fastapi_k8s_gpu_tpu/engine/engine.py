@@ -89,6 +89,17 @@ class _TextEmitter:
         self._n_emitted += len(ready)
         return ready, False
 
+    def step(self, gen: list, done: bool, finish: str) -> tuple[str, str, bool]:
+        """One emission step with the callers' shared hit convention applied:
+        returns (ready_text, finish, done) — a stop hit forces
+        ``("", "stop", True)``.  Extracted so the four call sites (the
+        loop tails and the first-token early emits in :meth:`Engine._run`
+        and :meth:`Engine._run_spec`) cannot drift."""
+        ready, hit = self.process(gen, live=not done)
+        if hit:
+            return "", "stop", True
+        return ready, finish, done
+
     def final(self, gen: list, finish: str) -> tuple[str, str]:
         """(text_tail, finish) once generation has ended: decode the whole
         stream, clip at a stop string, return what was never emitted."""
@@ -672,6 +683,11 @@ class Engine:
         stats = ctx.setdefault(
             "spec", {"verify_steps": 0, "drafted": 0, "accepted": 0,
                      "fallback_steps": 0})
+        # First-token early emit, as in _run: don't make the first text
+        # increment wait for the first verify/decode round trip.
+        ready, finish, done = em.step(gen, done, finish)
+        if ready:
+            yield ready, False, finish
         while not done:
             remaining = budget - len(gen)
             capacity = self.cfg.n_ctx - pos - 1   # cache slots left to write
@@ -706,11 +722,8 @@ class Engine:
             if not done and len(gen) >= budget:
                 done = True
 
-            ready, hit = em.process(gen, live=not done)
-            if hit:
-                finish = "stop"
-                done = True
-            elif ready:
+            ready, finish, done = em.step(gen, done, finish)
+            if ready:
                 yield ready, False, finish
 
         ctx["ids"] = gen
@@ -760,6 +773,14 @@ class Engine:
                 ctx["state"], ctx["st"], n_cur, ctx["sp"].top_k)
 
         done = pending is None
+        # Emit the first sampled token's text NOW — chunk 1 is already
+        # dispatched and overlaps with this yield.  Before this, the first
+        # content increment waited a full decode-chunk device round trip
+        # (~chunk×t_tok + RTT), which dominated server-level TTFT: the
+        # first token was materialized in _start but sat unemitted.
+        ready, finish, done = em.step(gen, done, finish)
+        if ready:
+            yield ready, False, finish
         while not done:
             # dispatch the NEXT chunk before touching the host copy of the
             # current one (speculating that no stop token appears)
@@ -780,11 +801,8 @@ class Engine:
             if pending is None:
                 done = True
 
-            ready, hit = em.process(gen, live=not done)
-            if hit:
-                finish = "stop"
-                done = True
-            elif ready:
+            ready, finish, done = em.step(gen, done, finish)
+            if ready:
                 yield ready, False, finish
 
         ctx["ids"] = gen
