@@ -402,10 +402,15 @@ def child_main() -> None:
                 and not os.path.isdir(cache_dir) and os.path.exists(seed)):
             import tarfile
             try:
+                def _norm(n):
+                    return n[2:] if n.startswith("./") else n
+
                 with tarfile.open(seed) as tf:
                     members = [m for m in tf.getmembers()
-                               if m.name == ".lfkt_xla_cache"
-                               or m.name.startswith(".lfkt_xla_cache/")]
+                               if _norm(m.name) == ".lfkt_xla_cache"
+                               or _norm(m.name).startswith(".lfkt_xla_cache/")]
+                    if not members:
+                        raise ValueError("no .lfkt_xla_cache/ members")
                     tf.extractall(repo, members=members, filter="data")
                 print(f"bench: seeded compile cache from {seed}",
                       file=sys.stderr, flush=True)

@@ -59,6 +59,52 @@ def test_seeded_sampling_deterministic(engine):
     assert a["choices"][0]["message"]["content"] == b["choices"][0]["message"]["content"]
 
 
+class _AsciiTokProxy:
+    """Delegates to the real tokenizer but decodes every token id to a
+    self-contained ASCII marker, so chunk-boundary assertions are immune to
+    the byte-level test vocab's UTF-8 holdback (a partial multi-byte char is
+    legitimately withheld, which would make chunk counts nondeterministic)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def stop_ids(self):
+        return set()      # never stop: the full budget must run
+
+    def decode_bytes(self, ids):
+        return b"".join(b"<%d>" % t for t in ids)
+
+    def decode(self, ids, skip_special=True):
+        return self.decode_bytes(ids).decode()
+
+
+def test_stream_emits_first_token_before_first_decode_chunk(tmp_path):
+    """Pins the first-token early emit (the server-TTFT fix): the first
+    content chunk must be exactly the first sampled token, emitted without
+    waiting for the first decode-chunk round trip.  With the whole budget
+    inside ONE decode chunk, the pre-fix loop emitted a single content
+    chunk after that chunk returned; the fix makes it two."""
+    import re
+
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    eng = Engine(path, n_ctx=128, decode_chunk=16, max_gen_tokens=8,
+                 prefill_buckets=(64,))
+    eng.tokenizer = _AsciiTokProxy(eng.tokenizer)
+    chunks = list(eng.create_chat_completion(MSGS, stream=True, seed=5))
+    content = [c["choices"][0]["delta"]["content"] for c in chunks
+               if c["choices"][0]["delta"].get("content")]
+    # budget 8 < decode_chunk 16 → exactly one decode dispatch: early emit
+    # (first token alone) + one chunk of the remaining 7 tokens
+    assert len(content) == 2, content
+    assert re.fullmatch(r"<\d+>", content[0]), content[0]
+    assert len(re.findall(r"<\d+>", content[1])) == 7, content[1]
+
+
 def test_streaming_matches_non_streaming(engine):
     kw = dict(temperature=0.0, max_tokens=8)
     full = engine.create_chat_completion(MSGS, **kw)
