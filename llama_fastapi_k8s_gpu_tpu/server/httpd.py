@@ -5,6 +5,16 @@ in-tree ASGI app without external packages: persistent connections,
 Content-Length framing, graceful shutdown via the ASGI lifespan protocol.
 One process, one event loop — the reference's single-worker model
 (``gunicorn -w 1``) is preserved by construction.
+
+Shutdown mirrors gunicorn's graceful stop: on SIGTERM/SIGINT the listener
+closes, idle keep-alive connections are closed immediately, in-flight
+requests (counted from their FIRST byte, so a mid-upload body is covered)
+get up to ``LFKT_DRAIN_SECONDS`` to complete with a ``connection: close``
+response, and only then does the ASGI shutdown hook run.  Surviving
+connections are force-closed AND their handler tasks cancelled after the
+drain budget, so ``Server.wait_closed`` (which on Python ≥3.12.1 waits for
+ALL connection handlers — including ones blocked inside the app, not on
+socket I/O) cannot hang the process past its pod termination grace period.
 """
 
 from __future__ import annotations
@@ -22,106 +32,143 @@ _REASONS = {
 }
 
 
+async def _handle_request(app, reader, writer, peer, request_line,
+                          state) -> bool:
+    """Serve one request on an open connection.  Returns False when the
+    connection must close (malformed request or draining)."""
+    try:
+        method, target, _version = request_line.decode().split()
+    except ValueError:
+        return False
+    headers = []
+    content_length = 0
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = line.decode().partition(":")
+        name = name.strip().lower()
+        value = value.strip()
+        headers.append((name.encode(), value.encode()))
+        if name == "content-length":
+            try:
+                content_length = int(value)
+            except ValueError:
+                return False        # malformed framing: close, like a bad
+            if content_length < 0:  # request line above
+                return False
+    body = await reader.readexactly(content_length) if content_length else b""
+
+    path, _, query = target.partition("?")
+    scope = {
+        "type": "http",
+        "asgi": {"version": "3.0"},
+        "http_version": "1.1",
+        "method": method.upper(),
+        "path": path,
+        "query_string": query.encode(),
+        "headers": headers,
+        "client": peer,
+        "scheme": "http",
+    }
+
+    messages = [{"type": "http.request", "body": body, "more_body": False}]
+
+    async def receive():
+        if messages:
+            return messages.pop(0)
+        return {"type": "http.disconnect"}
+
+    # Buffered by default; switches to chunked transfer-encoding the
+    # moment the app sends a body part with more_body=True (streaming
+    # responses — SSE /response/stream).
+    response = {"status": 500, "headers": [], "body": b"",
+                "streaming": False}
+
+    def _write_head(chunked: bool):
+        status = response["status"]
+        head = [f"HTTP/1.1 {status} {_REASONS.get(status, '')}".encode()]
+        has_length = False
+        for k, v in response["headers"]:
+            if k.lower() == b"content-length":
+                has_length = True
+            head.append(k + b": " + v)
+        if chunked:
+            head.append(b"transfer-encoding: chunked")
+        elif not has_length:
+            head.append(
+                b"content-length: " + str(len(response["body"])).encode())
+        # honest connection signaling: during drain the handler closes the
+        # socket after this response, so clients must not reuse it
+        head.append(b"connection: close" if state["draining"]
+                    else b"connection: keep-alive")
+        writer.write(b"\r\n".join(head) + b"\r\n\r\n")
+
+    async def send(message):
+        if message["type"] == "http.response.start":
+            response["status"] = message["status"]
+            response["headers"] = message.get("headers", [])
+        elif message["type"] == "http.response.body":
+            body = message.get("body", b"")
+            if message.get("more_body"):
+                if not response["streaming"]:
+                    response["streaming"] = True
+                    _write_head(chunked=True)
+                if body:
+                    writer.write(
+                        f"{len(body):x}\r\n".encode() + body + b"\r\n")
+                    await writer.drain()
+            elif response["streaming"]:
+                if body:
+                    writer.write(
+                        f"{len(body):x}\r\n".encode() + body + b"\r\n")
+                writer.write(b"0\r\n\r\n")
+                await writer.drain()
+            else:
+                response["body"] += body
+
+    await app(scope, receive, send)
+
+    if not response["streaming"]:
+        _write_head(chunked=False)
+        writer.write(response["body"])
+        await writer.drain()
+    return not state["draining"]
+
+
 async def _handle_connection(app, reader: asyncio.StreamReader,
-                             writer: asyncio.StreamWriter):
+                             writer: asyncio.StreamWriter, state: dict):
     peer = writer.get_extra_info("peername")
+    state["conns"].add(writer)
+    state["tasks"].add(asyncio.current_task())
     try:
         while True:
+            if state["draining"]:
+                break   # shutdown: no new requests on this connection
             request_line = await reader.readline()
             if not request_line:
                 break
+            # count the request from its FIRST byte: a request mid-upload
+            # when shutdown starts must be inside the drain accounting,
+            # not invisible until its body finishes arriving
+            state["active"] += 1
+            state["busy"].add(writer)
             try:
-                method, target, _version = request_line.decode().split()
-            except ValueError:
+                keep = await _handle_request(app, reader, writer, peer,
+                                             request_line, state)
+            finally:
+                state["active"] -= 1
+                state["busy"].discard(writer)
+                if state["draining"] and state["active"] == 0:
+                    state["idle"].set()
+            if not keep:
                 break
-            headers = []
-            content_length = 0
-            while True:
-                line = await reader.readline()
-                if line in (b"\r\n", b"\n", b""):
-                    break
-                name, _, value = line.decode().partition(":")
-                name = name.strip().lower()
-                value = value.strip()
-                headers.append((name.encode(), value.encode()))
-                if name == "content-length":
-                    content_length = int(value)
-            body = await reader.readexactly(content_length) if content_length else b""
-
-            path, _, query = target.partition("?")
-            scope = {
-                "type": "http",
-                "asgi": {"version": "3.0"},
-                "http_version": "1.1",
-                "method": method.upper(),
-                "path": path,
-                "query_string": query.encode(),
-                "headers": headers,
-                "client": peer,
-                "scheme": "http",
-            }
-
-            messages = [{"type": "http.request", "body": body, "more_body": False}]
-
-            async def receive():
-                if messages:
-                    return messages.pop(0)
-                return {"type": "http.disconnect"}
-
-            # Buffered by default; switches to chunked transfer-encoding the
-            # moment the app sends a body part with more_body=True (streaming
-            # responses — SSE /response/stream).
-            response = {"status": 500, "headers": [], "body": b"",
-                        "streaming": False}
-
-            def _write_head(chunked: bool):
-                status = response["status"]
-                head = [f"HTTP/1.1 {status} {_REASONS.get(status, '')}".encode()]
-                has_length = False
-                for k, v in response["headers"]:
-                    if k.lower() == b"content-length":
-                        has_length = True
-                    head.append(k + b": " + v)
-                if chunked:
-                    head.append(b"transfer-encoding: chunked")
-                elif not has_length:
-                    head.append(
-                        b"content-length: " + str(len(response["body"])).encode())
-                head.append(b"connection: keep-alive")
-                writer.write(b"\r\n".join(head) + b"\r\n\r\n")
-
-            async def send(message):
-                if message["type"] == "http.response.start":
-                    response["status"] = message["status"]
-                    response["headers"] = message.get("headers", [])
-                elif message["type"] == "http.response.body":
-                    body = message.get("body", b"")
-                    if message.get("more_body"):
-                        if not response["streaming"]:
-                            response["streaming"] = True
-                            _write_head(chunked=True)
-                        if body:
-                            writer.write(
-                                f"{len(body):x}\r\n".encode() + body + b"\r\n")
-                            await writer.drain()
-                    elif response["streaming"]:
-                        if body:
-                            writer.write(
-                                f"{len(body):x}\r\n".encode() + body + b"\r\n")
-                        writer.write(b"0\r\n\r\n")
-                        await writer.drain()
-                    else:
-                        response["body"] += body
-
-            await app(scope, receive, send)
-
-            if not response["streaming"]:
-                _write_head(chunked=False)
-                writer.write(response["body"])
-                await writer.drain()
     except (asyncio.IncompleteReadError, ConnectionResetError):
         pass
     finally:
+        state["conns"].discard(writer)
+        state["busy"].discard(writer)
+        state["tasks"].discard(asyncio.current_task())
         try:
             writer.close()
             await writer.wait_closed()
@@ -129,16 +176,41 @@ async def _handle_connection(app, reader: asyncio.StreamReader,
             pass
 
 
+def _close_conns(state: dict, only_idle: bool):
+    for w in list(state["conns"]):
+        if only_idle and w in state["busy"]:
+            continue
+        try:
+            w.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 async def serve(app, host: str = "0.0.0.0", port: int = 8000,
-                ready_event: asyncio.Event | None = None):
+                ready_event: asyncio.Event | None = None,
+                stop_event: asyncio.Event | None = None,
+                drain_seconds: float | None = None):
+    """Serve until SIGINT/SIGTERM (or ``stop_event``), then drain.
+
+    ``drain_seconds`` defaults to ``LFKT_DRAIN_SECONDS`` (30 — gunicorn's
+    graceful_timeout, the reference's termination behavior at
+    docker/Dockerfile.app:12; it also bounds the reference-parity 25 s
+    generation timeout with headroom).
+    """
+    if drain_seconds is None:
+        import os
+
+        drain_seconds = float(os.environ.get("LFKT_DRAIN_SECONDS", "30"))
     await app.router.startup()
+    state = {"active": 0, "draining": False, "idle": asyncio.Event(),
+             "conns": set(), "busy": set(), "tasks": set()}
     server = await asyncio.start_server(
-        lambda r, w: _handle_connection(app, r, w), host, port)
+        lambda r, w: _handle_connection(app, r, w, state), host, port)
     logger.info("httpd listening on %s:%d", host, port)
     if ready_event is not None:
         ready_event.set()
 
-    stop = asyncio.Event()
+    stop = stop_event if stop_event is not None else asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
@@ -149,6 +221,24 @@ async def serve(app, host: str = "0.0.0.0", port: int = 8000,
             pass
     async with server:
         await stop.wait()
+        state["draining"] = True
+        server.close()            # stop accepting; existing tasks continue
+        _close_conns(state, only_idle=True)   # idle keep-alives: EOF now
+        if state["active"]:
+            logger.info("httpd draining %d in-flight request(s) (≤%.0fs)",
+                        state["active"], drain_seconds)
+            try:
+                await asyncio.wait_for(state["idle"].wait(), drain_seconds)
+            except asyncio.TimeoutError:
+                logger.warning("httpd drain timed out after %.0fs; "
+                               "%d request(s) abandoned",
+                               drain_seconds, state["active"])
+        # Whatever survives is force-closed AND cancelled: a handler
+        # blocked inside the app (not on socket I/O) never notices a
+        # closed transport, and Server.wait_closed waits for it.
+        _close_conns(state, only_idle=False)
+        for t in list(state["tasks"]):
+            t.cancel()
     await app.router.shutdown()
 
 

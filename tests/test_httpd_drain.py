@@ -1,0 +1,258 @@
+"""Graceful-drain behavior of the in-tree httpd.
+
+Reference termination parity: gunicorn's default graceful shutdown
+finishes in-flight requests on SIGTERM (reference
+docker/Dockerfile.app:12); the in-tree server must not kill a
+mid-generation request when the pod receives its termination signal.
+"""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from llama_fastapi_k8s_gpu_tpu.engine.fake import FakeEngine
+from llama_fastapi_k8s_gpu_tpu.server import httpd
+from llama_fastapi_k8s_gpu_tpu.server.app import create_app
+
+PAYLOAD = json.dumps({
+    "bot_profile": {"name": "Ada", "appearance": "a,b,c,d",
+                    "system_prompt": "You are terse."},
+    "user_profile": {"name": "Sam"},
+    "context": [{"turn": "user", "message": "hi"}],
+}).encode()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_stop_drains_inflight_request_then_exits():
+    port = _free_port()
+    eng = FakeEngine(reply="drained ok", delay=1.5)
+    app = create_app(engine=eng)
+    holder: dict = {}
+    ready = threading.Event()
+
+    async def main():
+        holder["loop"] = asyncio.get_running_loop()
+        holder["stop"] = asyncio.Event()
+        r = asyncio.Event()
+        task = asyncio.create_task(httpd.serve(
+            app, "127.0.0.1", port, ready_event=r,
+            stop_event=holder["stop"], drain_seconds=10))
+        await r.wait()
+        ready.set()
+        await task
+
+    th = threading.Thread(target=lambda: asyncio.run(main()), daemon=True)
+    th.start()
+    assert ready.wait(10), "server never became ready"
+
+    results: dict = {}
+
+    def post():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/response", data=PAYLOAD,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                results["status"] = resp.status
+                results["body"] = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 — recorded for the assert
+            results["error"] = e
+
+    client = threading.Thread(target=post)
+    client.start()
+    time.sleep(0.5)          # request is mid-generation (engine delay 1.5s)
+    holder["loop"].call_soon_threadsafe(holder["stop"].set)
+
+    client.join(20)
+    assert results.get("status") == 200, results
+    assert results["body"]["response"] == "drained ok"
+
+    th.join(20)
+    assert not th.is_alive(), "serve() did not return after drain"
+    # the listener is down afterwards
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=2)
+        raise AssertionError("server still accepting after shutdown")
+    except (urllib.error.URLError, ConnectionError, OSError):
+        pass
+
+
+def _start_server(app, port, drain_seconds=10):
+    holder: dict = {}
+    ready = threading.Event()
+
+    async def main():
+        holder["loop"] = asyncio.get_running_loop()
+        holder["stop"] = asyncio.Event()
+        r = asyncio.Event()
+        task = asyncio.create_task(httpd.serve(
+            app, "127.0.0.1", port, ready_event=r,
+            stop_event=holder["stop"], drain_seconds=drain_seconds))
+        await r.wait()
+        ready.set()
+        await task
+
+    th = threading.Thread(target=lambda: asyncio.run(main()), daemon=True)
+    th.start()
+    assert ready.wait(10), "server never became ready"
+    holder["thread"] = th
+    return holder
+
+
+def _stop(holder):
+    holder["loop"].call_soon_threadsafe(holder["stop"].set)
+
+
+def _raw_request(body: bytes) -> bytes:
+    return (b"POST /response HTTP/1.1\r\n"
+            b"Host: x\r\nContent-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body)
+
+
+def _read_response(sock) -> tuple[int, bytes, bytes]:
+    """Read one HTTP/1.1 response off a raw socket: (status, head, body)."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        assert chunk, f"connection closed mid-head: {buf!r}"
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    clen = 0
+    for ln in head.split(b"\r\n"):
+        if ln.lower().startswith(b"content-length:"):
+            clen = int(ln.split(b":")[1])
+    while len(rest) < clen:
+        chunk = sock.recv(65536)
+        assert chunk, "connection closed mid-body"
+        rest += chunk
+    return status, head, rest[:clen]
+
+
+def test_idle_keepalive_socket_does_not_hang_shutdown():
+    """The reviewer-reproduced hang: Python >=3.12.1 Server.wait_closed
+    waits for every connection handler, so an idle keep-alive socket that
+    the client never closes would block serve() forever unless the drain
+    closes idle connections itself."""
+    port = _free_port()
+    holder = _start_server(create_app(engine=FakeEngine(reply="x")), port)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(_raw_request(PAYLOAD))
+        status, head, _body = _read_response(s)
+        assert status == 200
+        assert b"connection: keep-alive" in head.lower()
+        # socket now idle keep-alive and deliberately left open
+        t0 = time.time()
+        _stop(holder)
+        holder["thread"].join(8)
+        assert not holder["thread"].is_alive(), \
+            "serve() hung on an idle keep-alive connection"
+        assert time.time() - t0 < 6
+        assert s.recv(1024) == b"", "idle connection should get EOF"
+    finally:
+        s.close()
+
+
+def test_midupload_request_is_drained_with_connection_close():
+    """A request whose body is still arriving when shutdown starts is
+    counted by the drain (active from the first byte) and completes with
+    an honest 'connection: close' response."""
+    port = _free_port()
+    holder = _start_server(create_app(engine=FakeEngine(reply="late ok")),
+                           port)
+    s = socket.create_connection(("127.0.0.1", port), timeout=20)
+    try:
+        raw = _raw_request(PAYLOAD)
+        split = len(raw) - 20
+        s.sendall(raw[:split])          # head + partial body
+        time.sleep(0.3)                 # let the server start reading
+        _stop(holder)
+        time.sleep(0.3)                 # drain is now waiting on this request
+        s.sendall(raw[split:])          # complete the upload
+        status, head, body = _read_response(s)
+        assert status == 200, (status, head)
+        assert b"connection: close" in head.lower()
+        assert json.loads(body)["response"] == "late ok"
+        holder["thread"].join(10)
+        assert not holder["thread"].is_alive()
+    finally:
+        s.close()
+
+
+class _SlowApp:
+    """Minimal ASGI app whose handler never finishes: exercises the
+    drain-timeout cancellation (a task blocked inside the app never
+    notices a closed transport, and Server.wait_closed waits for it)."""
+
+    class _Router:
+        async def startup(self):
+            pass
+
+        async def shutdown(self):
+            pass
+
+    def __init__(self):
+        self.router = self._Router()
+
+    async def __call__(self, scope, receive, send):
+        await asyncio.sleep(60)
+
+
+def test_drain_timeout_cancels_stuck_handler():
+    port = _free_port()
+    holder = _start_server(_SlowApp(), port, drain_seconds=1)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(_raw_request(PAYLOAD))
+        time.sleep(0.3)              # handler now parked in the app
+        t0 = time.time()
+        _stop(holder)
+        holder["thread"].join(8)
+        assert not holder["thread"].is_alive(), \
+            "serve() waited on a stuck handler past the drain budget"
+        assert time.time() - t0 < 6
+    finally:
+        s.close()
+
+
+def test_stop_with_no_inflight_exits_promptly():
+    port = _free_port()
+    app = create_app(engine=FakeEngine(reply="x"))
+    holder: dict = {}
+    ready = threading.Event()
+
+    async def main():
+        holder["loop"] = asyncio.get_running_loop()
+        holder["stop"] = asyncio.Event()
+        r = asyncio.Event()
+        task = asyncio.create_task(httpd.serve(
+            app, "127.0.0.1", port, ready_event=r,
+            stop_event=holder["stop"], drain_seconds=10))
+        await r.wait()
+        ready.set()
+        await task
+
+    th = threading.Thread(target=lambda: asyncio.run(main()), daemon=True)
+    th.start()
+    assert ready.wait(10)
+    # one completed request so the connection is idle keep-alive at stop time
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/response", data=PAYLOAD,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+    t0 = time.time()
+    holder["loop"].call_soon_threadsafe(holder["stop"].set)
+    th.join(10)
+    assert not th.is_alive()
+    assert time.time() - t0 < 5, "idle shutdown should not wait for drain"
