@@ -44,8 +44,21 @@ def main():
 
         run(app, host, port)
         return
+    # the same graceful-drain budget the in-tree httpd honors: without it
+    # uvicorn's SIGTERM handling applies no bounded drain and the
+    # documented LFKT_DRAIN_SECONDS knob would be a no-op in the
+    # production (uvicorn-installed) image.  The kwarg exists since
+    # uvicorn 0.20 (requirements.txt floats); degrade rather than refuse
+    # to serve on an older pin.
+    import inspect
+
+    drain = float(os.environ.get("LFKT_DRAIN_SECONDS", "30"))
+    kw = {}
+    if "timeout_graceful_shutdown" in inspect.signature(
+            uvicorn.Config).parameters:
+        kw["timeout_graceful_shutdown"] = int(drain)
     uvicorn.run("llama_fastapi_k8s_gpu_tpu.server.app:app",
-                host=host, port=port, workers=1)
+                host=host, port=port, workers=1, **kw)
 
 
 if __name__ == "__main__":

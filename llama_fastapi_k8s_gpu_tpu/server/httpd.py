@@ -8,7 +8,8 @@ One process, one event loop — the reference's single-worker model
 
 Shutdown mirrors gunicorn's graceful stop: on SIGTERM/SIGINT the listener
 closes, idle keep-alive connections are closed immediately, in-flight
-requests (counted from their FIRST byte, so a mid-upload body is covered)
+requests (counted from their first COMPLETE request line, so a mid-upload
+header/body is covered; a partial request line at stop is treated as idle)
 get up to ``LFKT_DRAIN_SECONDS`` to complete with a ``connection: close``
 response, and only then does the ASGI shutdown hook run.  Surviving
 connections are force-closed AND their handler tasks cancelled after the
@@ -148,9 +149,9 @@ async def _handle_connection(app, reader: asyncio.StreamReader,
             request_line = await reader.readline()
             if not request_line:
                 break
-            # count the request from its FIRST byte: a request mid-upload
-            # when shutdown starts must be inside the drain accounting,
-            # not invisible until its body finishes arriving
+            # count the request from its first complete request line: a
+            # request mid-upload (headers/body still arriving) when
+            # shutdown starts must be inside the drain accounting
             state["active"] += 1
             state["busy"].add(writer)
             try:

@@ -44,6 +44,13 @@ class Settings:
     # its queue slot indefinitely (no reference equivalent: it has no
     # streaming at all, reference api.py:58)
     stream_deadline_seconds: float = 300.0
+    # graceful-shutdown budget: on SIGTERM in-flight requests get this long
+    # to finish (gunicorn graceful_timeout analogue — the reference's
+    # termination behavior at docker/Dockerfile.app:12).  Honored by both
+    # the in-tree httpd and the uvicorn path; keep the pod's
+    # terminationGracePeriodSeconds above it (helm derives grace from the
+    # same values knob)
+    drain_seconds: float = 30.0
 
     # Fixed sampling parameters the reference passes at api.py:59-62; the
     # remaining knobs take llama-cpp-python 0.2.77 defaults (top_k=40,
@@ -121,6 +128,7 @@ def get_settings() -> Settings:
         model_name=_env("LFKT_MODEL_NAME", Settings.model_name),
         max_context_tokens=_env("LFKT_MAX_CONTEXT_TOKENS", Settings.max_context_tokens, int),
         timeout_seconds=_env("LFKT_TIMEOUT_SECONDS", Settings.timeout_seconds, float),
+        drain_seconds=_env("LFKT_DRAIN_SECONDS", Settings.drain_seconds, float),
         max_queue_size=_env("LFKT_MAX_QUEUE_SIZE", Settings.max_queue_size, int),
         stream_deadline_seconds=_env("LFKT_STREAM_DEADLINE_SECONDS",
                                      Settings.stream_deadline_seconds, float),
