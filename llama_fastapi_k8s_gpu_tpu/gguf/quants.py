@@ -16,6 +16,11 @@ Layout notes (all little-endian):
 
 - ``Q8_0``  block=32:   f16 d | 32×i8 q;            y = d*q
 - ``Q4_0``  block=32:   f16 d | 16B nibbles;        y = d*(q-8)
+- ``Q4_1``  block=32:   f16 d | f16 m | 16B nibbles; y = d*q + m
+- ``Q5_0``  block=32:   f16 d | u32 qh | 16B nibbles; y = d*(q-16),
+                        q = nibble | (qh-bit << 4); element j gets qh bit j,
+                        element j+16 gets qh bit j+16
+- ``Q5_1``  block=32:   f16 d | f16 m | u32 qh | 16B nibbles; y = d*q + m
 - ``Q4_K``  block=256:  f16 d | f16 dmin | 12B 6-bit scales/mins | 128B nibbles
                         y = d*sc[j]*q - dmin*m[j], 8 sub-blocks of 32
 - ``Q5_K``  block=256:  f16 d | f16 dmin | 12B scales | 32B qh | 128B qs
@@ -109,6 +114,112 @@ def quant_q4_0(x: np.ndarray) -> np.ndarray:
     out = np.empty((x.shape[0], 18), dtype=np.uint8)
     out[:, :2] = d.view(np.uint8).reshape(-1, 2)
     out[:, 2:] = q[:, :16] | (q[:, 16:] << 4)
+    return out.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Q4_1 / Q5_0 / Q5_1 (legacy affine/5-bit formats, still common in the wild)
+# ---------------------------------------------------------------------------
+
+def dequant_q4_1(buf: np.ndarray, n: int) -> np.ndarray:
+    nb = n // 32
+    blocks = buf[: nb * 20].reshape(nb, 20)
+    d = _f16(blocks[:, 0:2].reshape(-1))
+    m = _f16(blocks[:, 2:4].reshape(-1))
+    qs = blocks[:, 4:]
+    lo = (qs & 0x0F).astype(np.float32)   # elements 0..15
+    hi = (qs >> 4).astype(np.float32)     # elements 16..31
+    q = np.concatenate([lo, hi], axis=1)
+    return (d[:, None] * q + m[:, None]).reshape(-1)
+
+
+def quant_q4_1(x: np.ndarray) -> np.ndarray:
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1, 32)
+    mn = x.min(axis=1)
+    mx = x.max(axis=1)
+    d = ((mx - mn) / 15.0).astype(np.float16)
+    m = mn.astype(np.float16)
+    inv = np.where(d > 0, 1.0 / d.astype(np.float32), 0.0)
+    q = np.clip(np.round((x - mn[:, None]) * inv[:, None]), 0, 15).astype(np.uint8)
+    out = np.empty((x.shape[0], 20), dtype=np.uint8)
+    out[:, 0:2] = d.view(np.uint8).reshape(-1, 2)
+    out[:, 2:4] = m.view(np.uint8).reshape(-1, 2)
+    out[:, 4:] = q[:, :16] | (q[:, 16:] << 4)
+    return out.reshape(-1)
+
+
+def _q5_high_bits(qh_bytes: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(nb, 4) uint8 → ((nb, 16), (nb, 16)) fifth-bit planes already shifted
+    to bit 4: element j takes qh bit j, element j+16 takes qh bit j+16."""
+    qh = qh_bytes.copy().view(np.uint32).reshape(-1)  # (nb,)
+    j = np.arange(16, dtype=np.uint32)
+    lo = (((qh[:, None] >> j) & 1) << 4).astype(np.uint8)
+    hi = (((qh[:, None] >> (j + 16)) & 1) << 4).astype(np.uint8)
+    return lo, hi
+
+
+def dequant_q5_0(buf: np.ndarray, n: int) -> np.ndarray:
+    nb = n // 32
+    blocks = buf[: nb * 22].reshape(nb, 22)
+    d = _f16(blocks[:, 0:2].reshape(-1))
+    xh0, xh1 = _q5_high_bits(blocks[:, 2:6])
+    qs = blocks[:, 6:]
+    lo = ((qs & 0x0F) | xh0).astype(np.float32) - 16.0
+    hi = ((qs >> 4) | xh1).astype(np.float32) - 16.0
+    return (d[:, None] * np.concatenate([lo, hi], axis=1)).reshape(-1)
+
+
+def quant_q5_0(x: np.ndarray) -> np.ndarray:
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1, 32)
+    # like Q4_0: d from the max-|x| element so it maps to -16
+    idx = np.abs(x).argmax(axis=1)
+    maxv = x[np.arange(x.shape[0]), idx]
+    d = (maxv / -16.0).astype(np.float16)
+    inv = np.where(d != 0, 1.0 / d.astype(np.float32), 0.0)
+    q = np.clip(np.round(x * inv[:, None]) + 16, 0, 31).astype(np.uint8)
+    return _pack_q5(q, d.view(np.uint8).reshape(-1, 2), None)
+
+
+def dequant_q5_1(buf: np.ndarray, n: int) -> np.ndarray:
+    nb = n // 32
+    blocks = buf[: nb * 24].reshape(nb, 24)
+    d = _f16(blocks[:, 0:2].reshape(-1))
+    m = _f16(blocks[:, 2:4].reshape(-1))
+    xh0, xh1 = _q5_high_bits(blocks[:, 4:8])
+    qs = blocks[:, 8:]
+    lo = ((qs & 0x0F) | xh0).astype(np.float32)
+    hi = ((qs >> 4) | xh1).astype(np.float32)
+    q = np.concatenate([lo, hi], axis=1)
+    return (d[:, None] * q + m[:, None]).reshape(-1)
+
+
+def quant_q5_1(x: np.ndarray) -> np.ndarray:
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1, 32)
+    mn = x.min(axis=1)
+    mx = x.max(axis=1)
+    d = ((mx - mn) / 31.0).astype(np.float16)
+    m = mn.astype(np.float16)
+    inv = np.where(d > 0, 1.0 / d.astype(np.float32), 0.0)
+    q = np.clip(np.round((x - mn[:, None]) * inv[:, None]), 0, 31).astype(np.uint8)
+    return _pack_q5(q, d.view(np.uint8).reshape(-1, 2),
+                    m.view(np.uint8).reshape(-1, 2))
+
+
+def _pack_q5(q: np.ndarray, d_bytes: np.ndarray,
+             m_bytes: np.ndarray | None) -> np.ndarray:
+    """(nb, 32) 5-bit values + scale (+min) bytes → Q5_0/Q5_1 raw blocks."""
+    nb = q.shape[0]
+    j = np.arange(16, dtype=np.uint32)
+    qh = (((q[:, :16] >> 4).astype(np.uint32) << j).sum(axis=1)
+          | ((q[:, 16:] >> 4).astype(np.uint32) << (j + 16)).sum(axis=1))
+    qs = (q[:, :16] & 0x0F) | ((q[:, 16:] & 0x0F) << 4)
+    head = 2 if m_bytes is None else 4
+    out = np.empty((nb, head + 4 + 16), dtype=np.uint8)
+    out[:, 0:2] = d_bytes
+    if m_bytes is not None:
+        out[:, 2:4] = m_bytes
+    out[:, head:head + 4] = qh.astype(np.uint32).view(np.uint8).reshape(nb, 4)
+    out[:, head + 4:] = qs
     return out.reshape(-1)
 
 
@@ -334,6 +445,9 @@ DEQUANT = {
     GGMLType.F16: dequant_f16,
     GGMLType.BF16: dequant_bf16,
     GGMLType.Q4_0: dequant_q4_0,
+    GGMLType.Q4_1: dequant_q4_1,
+    GGMLType.Q5_0: dequant_q5_0,
+    GGMLType.Q5_1: dequant_q5_1,
     GGMLType.Q8_0: dequant_q8_0,
     GGMLType.Q4_K: dequant_q4_k,
     GGMLType.Q5_K: dequant_q5_k,
@@ -345,6 +459,9 @@ QUANT = {
     GGMLType.F16: lambda x: np.ascontiguousarray(x, dtype=np.float32).astype(np.float16).view(np.uint8),
     GGMLType.BF16: quant_bf16,
     GGMLType.Q4_0: quant_q4_0,
+    GGMLType.Q4_1: quant_q4_1,
+    GGMLType.Q5_0: quant_q5_0,
+    GGMLType.Q5_1: quant_q5_1,
     GGMLType.Q8_0: quant_q8_0,
     GGMLType.Q4_K: quant_q4_k,
     GGMLType.Q5_K: quant_q5_k,

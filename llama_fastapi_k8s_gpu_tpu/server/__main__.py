@@ -51,12 +51,17 @@ def main():
     # uvicorn 0.20 (requirements.txt floats); degrade rather than refuse
     # to serve on an older pin.
     import inspect
+    import math
 
-    drain = float(os.environ.get("LFKT_DRAIN_SECONDS", "30"))
+    from ..utils.config import get_settings
+
+    drain = get_settings().drain_seconds
     kw = {}
     if "timeout_graceful_shutdown" in inspect.signature(
             uvicorn.Config).parameters:
-        kw["timeout_graceful_shutdown"] = int(drain)
+        # uvicorn takes whole seconds; never truncate a small budget to an
+        # immediate-cancel 0
+        kw["timeout_graceful_shutdown"] = max(1, math.ceil(drain))
     uvicorn.run("llama_fastapi_k8s_gpu_tpu.server.app:app",
                 host=host, port=port, workers=1, **kw)
 

@@ -199,9 +199,11 @@ async def serve(app, host: str = "0.0.0.0", port: int = 8000,
     generation timeout with headroom).
     """
     if drain_seconds is None:
-        import os
+        # one parse site for the knob (utils/config.py registers it);
+        # local import keeps this module's top-level deps stdlib-only
+        from ..utils.config import get_settings
 
-        drain_seconds = float(os.environ.get("LFKT_DRAIN_SECONDS", "30"))
+        drain_seconds = get_settings().drain_seconds
     await app.router.startup()
     state = {"active": 0, "draining": False, "idle": asyncio.Event(),
              "conns": set(), "busy": set(), "tasks": set()}

@@ -142,6 +142,22 @@ def test_q4k_model_loads(tmp_path):
     assert isinstance(out["choices"][0]["message"]["content"], str)
 
 
+def test_legacy_quant_files_load_and_serve(tmp_path):
+    """Q4_1/Q5_0/Q5_1 GGUFs (legacy affine/5-bit formats, still common in
+    the wild) load through the int8 requant path and serve — the same
+    serving decision as Q4_0 (llama.cpp loads all of these,
+    reference api.py:24-28)."""
+    for gtype in (GGMLType.Q4_1, GGMLType.Q5_0, GGMLType.Q5_1):
+        path = str(tmp_path / f"{gtype.name.lower()}.gguf")
+        write_tiny_llama_gguf(path, quant=gtype, ffn_quant=gtype)
+        eng = Engine(path, n_ctx=64, decode_chunk=2, max_gen_tokens=4,
+                     prefill_buckets=(32, 64), weight_format="int8")
+        out = eng.create_chat_completion(
+            [{"role": "user", "content": "hi"}], temperature=0.0,
+            max_tokens=3)
+        assert out["usage"]["completion_tokens"] >= 1, gtype.name
+
+
 def test_f16_file_serves_int8_decision():
     """BASELINE config #3's F16 GGUF variant: a file with no fused-eligible
     quantized tensors must resolve EXPLICITLY to int8 serving (8B bf16 can't

@@ -57,6 +57,55 @@ def scalar_dequant_q4_0(raw):
     return np.array(out, dtype=np.float32)
 
 
+def scalar_dequant_q4_1(raw):
+    out = []
+    for blk in raw.reshape(-1, 20):
+        d = _f16(blk[0], blk[1])
+        m = _f16(blk[2], blk[3])
+        qs = blk[4:]
+        vals = [0.0] * 32
+        for l in range(16):
+            vals[l] = float(d) * (int(qs[l]) & 0x0F) + float(m)
+            vals[l + 16] = float(d) * (int(qs[l]) >> 4) + float(m)
+        out.extend(vals)
+    return np.array(out, dtype=np.float32)
+
+
+def scalar_dequant_q5_0(raw):
+    # transcribed from llama.cpp dequantize_row_q5_0: xh_0 = bit j of qh at
+    # position 4, xh_1 = bit (j+16)
+    out = []
+    for blk in raw.reshape(-1, 22):
+        d = _f16(blk[0], blk[1])
+        qh = int.from_bytes(bytes(blk[2:6]), "little")
+        qs = blk[6:]
+        vals = [0.0] * 32
+        for j in range(16):
+            xh_0 = ((qh >> j) << 4) & 0x10
+            xh_1 = (qh >> (j + 12)) & 0x10
+            vals[j] = float(d) * (((int(qs[j]) & 0x0F) | xh_0) - 16)
+            vals[j + 16] = float(d) * (((int(qs[j]) >> 4) | xh_1) - 16)
+        out.extend(vals)
+    return np.array(out, dtype=np.float32)
+
+
+def scalar_dequant_q5_1(raw):
+    out = []
+    for blk in raw.reshape(-1, 24):
+        d = _f16(blk[0], blk[1])
+        m = _f16(blk[2], blk[3])
+        qh = int.from_bytes(bytes(blk[4:8]), "little")
+        qs = blk[8:]
+        vals = [0.0] * 32
+        for j in range(16):
+            xh_0 = ((qh >> j) << 4) & 0x10
+            xh_1 = (qh >> (j + 12)) & 0x10
+            vals[j] = float(d) * ((int(qs[j]) & 0x0F) | xh_0) + float(m)
+            vals[j + 16] = float(d) * ((int(qs[j]) >> 4) | xh_1) + float(m)
+        out.extend(vals)
+    return np.array(out, dtype=np.float32)
+
+
 def scalar_dequant_q4_k(raw):
     out = []
     for blk in raw.reshape(-1, 144):
@@ -138,9 +187,10 @@ def _random_blocks(gtype: GGMLType, nb: int) -> np.ndarray:
     """Random valid raw blocks: random payload bytes, sane f16 scales."""
     _, bsize = GGML_BLOCK_SIZES[gtype]
     raw = rng.integers(0, 256, size=(nb, bsize), dtype=np.uint8)
-    if gtype in (GGMLType.Q8_0, GGMLType.Q4_0):
+    if gtype in (GGMLType.Q8_0, GGMLType.Q4_0, GGMLType.Q5_0):
         raw[:, 0:2] = _rand_f16_bytes(nb)
-    elif gtype in (GGMLType.Q4_K, GGMLType.Q5_K):
+    elif gtype in (GGMLType.Q4_K, GGMLType.Q5_K, GGMLType.Q4_1,
+                   GGMLType.Q5_1):
         raw[:, 0:2] = _rand_f16_bytes(nb)
         raw[:, 2:4] = _rand_f16_bytes(nb)
     elif gtype == GGMLType.Q6_K:
@@ -151,6 +201,9 @@ def _random_blocks(gtype: GGMLType, nb: int) -> np.ndarray:
 SCALAR = {
     GGMLType.Q8_0: scalar_dequant_q8_0,
     GGMLType.Q4_0: scalar_dequant_q4_0,
+    GGMLType.Q4_1: scalar_dequant_q4_1,
+    GGMLType.Q5_0: scalar_dequant_q5_0,
+    GGMLType.Q5_1: scalar_dequant_q5_1,
     GGMLType.Q4_K: scalar_dequant_q4_k,
     GGMLType.Q5_K: scalar_dequant_q5_k,
     GGMLType.Q6_K: scalar_dequant_q6_k,
@@ -172,6 +225,9 @@ def test_dequant_matches_scalar_reference(gtype):
     [
         (GGMLType.Q8_0, 0.02),
         (GGMLType.Q4_0, 0.20),
+        (GGMLType.Q4_1, 0.15),
+        (GGMLType.Q5_0, 0.10),
+        (GGMLType.Q5_1, 0.08),
         (GGMLType.Q4_K, 0.15),
         (GGMLType.Q5_K, 0.08),
         (GGMLType.Q6_K, 0.05),
