@@ -21,6 +21,10 @@ Layout notes (all little-endian):
                         q = nibble | (qh-bit << 4); element j gets qh bit j,
                         element j+16 gets qh bit j+16
 - ``Q5_1``  block=32:   f16 d | f16 m | u32 qh | 16B nibbles; y = d*q + m
+- ``Q2_K``  block=256:  16B 4-bit scale/min pairs | 64B 2-bit qs | f16 d | f16 dmin
+                        y = d*sc[j]*q - dmin*m[j], 16 sub-blocks of 16
+- ``Q3_K``  block=256:  32B hmask | 64B 2-bit qs | 12B 6-bit signed scales | f16 d
+                        q = 2 low bits + hmask high bit (clear ⇒ −4)
 - ``Q4_K``  block=256:  f16 d | f16 dmin | 12B 6-bit scales/mins | 128B nibbles
                         y = d*sc[j]*q - dmin*m[j], 8 sub-blocks of 32
 - ``Q5_K``  block=256:  f16 d | f16 dmin | 12B scales | 32B qh | 128B qs
@@ -254,6 +258,160 @@ def pack_scale_min_k4(sc: np.ndarray, mn: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Q2_K — 16 sub-blocks of 16; 4-bit scale + 4-bit min per sub-block,
+# superblock f16 d/dmin; 2-bit quants.  Layout per llama.cpp block_q2_K:
+# scales[16] | qs[64] | d | dmin (84 B).  Element order: two 128-halves;
+# within a half, shift ∈ {0,2,4,6} over qs bytes [0:16] then [16:32].
+# ---------------------------------------------------------------------------
+
+def dequant_q2_k(buf: np.ndarray, n: int) -> np.ndarray:
+    nb = n // QK_K
+    bs = GGML_BLOCK_SIZES[GGMLType.Q2_K][1]  # 84
+    blocks = buf[: nb * bs].reshape(nb, bs)
+    scales = blocks[:, :16]
+    qs = blocks[:, 16:80].reshape(nb, 2, 32)
+    d = _f16(blocks[:, 80:82].reshape(-1))
+    dmin = _f16(blocks[:, 82:84].reshape(-1))
+    dl = d[:, None] * (scales & 0x0F).astype(np.float32)    # (nb, 16)
+    ml = dmin[:, None] * (scales >> 4).astype(np.float32)   # (nb, 16)
+    parts = []
+    for h in range(2):
+        for s in range(0, 8, 2):
+            parts.append((qs[:, h, :16] >> s) & 3)
+            parts.append((qs[:, h, 16:] >> s) & 3)
+    qv = np.stack(parts, axis=1).astype(np.float32)          # (nb, 16, 16)
+    return (dl[:, :, None] * qv - ml[:, :, None]).reshape(-1)
+
+
+def quant_q2_k(x: np.ndarray) -> np.ndarray:
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1, QK_K)
+    nb = x.shape[0]
+    sub = x.reshape(nb, 16, 16)
+    mn = sub.min(axis=2)
+    mx = sub.max(axis=2)
+    ml_sub = np.maximum(-mn, 0.0)                 # y = dl*q - ml, q ∈ 0..3
+    dl_sub = np.maximum(mx + ml_sub, 0.0) / 3.0
+    d = (dl_sub.max(axis=1) / 15.0).astype(np.float16)
+    dmin = (ml_sub.max(axis=1) / 15.0).astype(np.float16)
+    invd = np.where(d > 0, 1.0 / d.astype(np.float32), 0.0)
+    invm = np.where(dmin > 0, 1.0 / dmin.astype(np.float32), 0.0)
+    sc4 = np.clip(np.round(dl_sub * invd[:, None]), 0, 15).astype(np.uint8)
+    mn4 = np.clip(np.round(ml_sub * invm[:, None]), 0, 15).astype(np.uint8)
+    dl_q = d.astype(np.float32)[:, None] * sc4
+    ml_q = dmin.astype(np.float32)[:, None] * mn4
+    inv_dl = np.where(dl_q > 0, 1.0 / dl_q, 0.0)
+    q = np.clip(np.round((sub + ml_q[:, :, None]) * inv_dl[:, :, None]),
+                0, 3).astype(np.uint8)            # (nb, 16, 16)
+    out = np.empty((nb, 84), dtype=np.uint8)
+    out[:, :16] = sc4 | (mn4 << 4)
+    # invert the element order: sub-block k = (half, shift, lo/hi 16)
+    qs = np.zeros((nb, 2, 32), dtype=np.uint8)
+    k = 0
+    for h in range(2):
+        for s in range(0, 8, 2):
+            qs[:, h, :16] |= q[:, k] << s
+            qs[:, h, 16:] |= q[:, k + 1] << s
+            k += 2
+    out[:, 16:80] = qs.reshape(nb, 64)
+    out[:, 80:82] = d.view(np.uint8).reshape(-1, 2)
+    out[:, 82:84] = dmin.view(np.uint8).reshape(-1, 2)
+    return out.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Q3_K — 16 sub-blocks of 16; 6-bit signed scales (−32..31) packed in 12 B,
+# superblock f16 d; 3-bit quants = 2 low bits in qs + 1 high bit in hmask
+# (bit clear ⇒ −4 offset).  Layout per llama.cpp block_q3_K:
+# hmask[32] | qs[64] | scales[12] | d (110 B).  Same two-half/shift element
+# order as Q2_K; the hmask bit index runs 0..7 ACROSS both halves.
+# ---------------------------------------------------------------------------
+
+def _q3k_unpack_scales(sb: np.ndarray) -> np.ndarray:
+    """(nb, 12) uint8 → (nb, 16) float32 scales in −32..31 (bias removed),
+    mirroring llama.cpp's kmask aux munging bytewise."""
+    k = np.arange(4)
+    a0 = (sb[:, k] & 0x0F) | ((sb[:, 8 + k] & 3) << 4)
+    a1 = (sb[:, 4 + k] & 0x0F) | (((sb[:, 8 + k] >> 2) & 3) << 4)
+    a2 = (sb[:, k] >> 4) | (((sb[:, 8 + k] >> 4) & 3) << 4)
+    a3 = (sb[:, 4 + k] >> 4) | (((sb[:, 8 + k] >> 6) & 3) << 4)
+    return np.concatenate([a0, a1, a2, a3], axis=1).astype(np.float32) - 32.0
+
+
+def _q3k_pack_scales(sc6: np.ndarray) -> np.ndarray:
+    """(nb, 16) uint8 6-bit (bias-32 applied by caller) → (nb, 12) bytes."""
+    sc6 = sc6.astype(np.uint8)
+    nb = sc6.shape[0]
+    out = np.zeros((nb, 12), dtype=np.uint8)
+    k = np.arange(4)
+    out[:, k] = (sc6[:, k] & 0x0F) | ((sc6[:, 8 + k] & 0x0F) << 4)
+    out[:, 4 + k] = (sc6[:, 4 + k] & 0x0F) | ((sc6[:, 12 + k] & 0x0F) << 4)
+    out[:, 8 + k] = (((sc6[:, k] >> 4) & 3)
+                     | (((sc6[:, 4 + k] >> 4) & 3) << 2)
+                     | (((sc6[:, 8 + k] >> 4) & 3) << 4)
+                     | (((sc6[:, 12 + k] >> 4) & 3) << 6))
+    return out
+
+
+def dequant_q3_k(buf: np.ndarray, n: int) -> np.ndarray:
+    nb = n // QK_K
+    bs = GGML_BLOCK_SIZES[GGMLType.Q3_K][1]  # 110
+    blocks = buf[: nb * bs].reshape(nb, bs)
+    hm = blocks[:, :32]
+    qs = blocks[:, 32:96].reshape(nb, 2, 32)
+    d = _f16(blocks[:, 108:110].reshape(-1))
+    dl = d[:, None] * _q3k_unpack_scales(blocks[:, 96:108])  # (nb, 16)
+    parts = []
+    for h in range(2):
+        for j in range(4):
+            m = 1 << (4 * h + j)
+            s = 2 * j
+            lo = ((qs[:, h, :16] >> s) & 3).astype(np.float32) \
+                - np.where(hm[:, :16] & m, 0.0, 4.0)
+            hi = ((qs[:, h, 16:] >> s) & 3).astype(np.float32) \
+                - np.where(hm[:, 16:] & m, 0.0, 4.0)
+            parts.append(lo)
+            parts.append(hi)
+    qv = np.stack(parts, axis=1)                             # (nb, 16, 16)
+    return (dl[:, :, None] * qv).reshape(-1)
+
+
+def quant_q3_k(x: np.ndarray) -> np.ndarray:
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1, QK_K)
+    nb = x.shape[0]
+    sub = x.reshape(nb, 16, 16)
+    # symmetric per-sub-block fit onto −4..3 (like Q4_0's max-|x|→−end)
+    idx = np.abs(sub).argmax(axis=2)
+    maxv = np.take_along_axis(sub, idx[:, :, None], axis=2)[:, :, 0]
+    dl_sub = maxv / -4.0
+    amax = np.abs(dl_sub).max(axis=1)
+    d = np.where(amax > 0, amax / 31.0, 0.0).astype(np.float16)
+    invd = np.where(d > 0, 1.0 / d.astype(np.float32), 0.0)
+    sc = np.clip(np.round(dl_sub * invd[:, None]), -32, 31)  # (nb, 16)
+    dl_q = d.astype(np.float32)[:, None] * sc
+    inv_dl = np.where(dl_q != 0, 1.0 / dl_q, 0.0)
+    q = np.clip(np.round(sub * inv_dl[:, :, None]), -4, 3).astype(np.int8)
+    qplus = (q + 4).astype(np.uint8)            # 0..7: 2 low bits + hm bit
+    out = np.empty((nb, 110), dtype=np.uint8)
+    hm = np.zeros((nb, 32), dtype=np.uint8)
+    qs = np.zeros((nb, 2, 32), dtype=np.uint8)
+    k = 0
+    for h in range(2):
+        for j in range(4):
+            m = 1 << (4 * h + j)
+            s = 2 * j
+            qs[:, h, :16] |= (qplus[:, k] & 3) << s
+            qs[:, h, 16:] |= (qplus[:, k + 1] & 3) << s
+            hm[:, :16] |= np.where(qplus[:, k] & 4, m, 0).astype(np.uint8)
+            hm[:, 16:] |= np.where(qplus[:, k + 1] & 4, m, 0).astype(np.uint8)
+            k += 2
+    out[:, :32] = hm
+    out[:, 32:96] = qs.reshape(nb, 64)
+    out[:, 96:108] = _q3k_pack_scales((sc + 32).astype(np.uint8))
+    out[:, 108:110] = d.view(np.uint8).reshape(-1, 2)
+    return out.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
 # Q4_K
 # ---------------------------------------------------------------------------
 
@@ -449,6 +607,8 @@ DEQUANT = {
     GGMLType.Q5_0: dequant_q5_0,
     GGMLType.Q5_1: dequant_q5_1,
     GGMLType.Q8_0: dequant_q8_0,
+    GGMLType.Q2_K: dequant_q2_k,
+    GGMLType.Q3_K: dequant_q3_k,
     GGMLType.Q4_K: dequant_q4_k,
     GGMLType.Q5_K: dequant_q5_k,
     GGMLType.Q6_K: dequant_q6_k,
@@ -463,6 +623,8 @@ QUANT = {
     GGMLType.Q5_0: quant_q5_0,
     GGMLType.Q5_1: quant_q5_1,
     GGMLType.Q8_0: quant_q8_0,
+    GGMLType.Q2_K: quant_q2_k,
+    GGMLType.Q3_K: quant_q3_k,
     GGMLType.Q4_K: quant_q4_k,
     GGMLType.Q5_K: quant_q5_k,
     GGMLType.Q6_K: quant_q6_k,

@@ -158,6 +158,35 @@ def test_legacy_quant_files_load_and_serve(tmp_path):
         assert out["usage"]["completion_tokens"] >= 1, gtype.name
 
 
+def test_q2k_q3k_files_load_and_serve(tmp_path):
+    """Q2_K / Q3_K GGUFs (the low-bit K-quants llama.cpp ships as
+    Q2_K / Q3_K_M files) load through the int8 requant path and serve —
+    completing the K-quant read family Q2..Q8."""
+    from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig(vocab_size=263, dim=256, n_layers=1, n_heads=4,
+                      n_kv_heads=2, ffn_dim=256, n_ctx=64, rope_theta=1e4)
+    for gtype in (GGMLType.Q2_K, GGMLType.Q3_K):
+        path = str(tmp_path / f"{gtype.name.lower()}.gguf")
+        write_tiny_llama_gguf(path, cfg, quant=gtype, ffn_quant=gtype)
+        eng = Engine(path, n_ctx=64, decode_chunk=2, max_gen_tokens=4,
+                     prefill_buckets=(32, 64), weight_format="int8")
+        out = eng.create_chat_completion(
+            [{"role": "user", "content": "hi"}], temperature=0.0,
+            max_tokens=3)
+        assert out["usage"]["completion_tokens"] >= 1, gtype.name
+    # the realistic Q3_K_M shape: Q3_K bulk + higher K-quants on the
+    # use_more_bits tensors, through the AUTO format decision
+    path = str(tmp_path / "q3km.gguf")
+    write_tiny_llama_gguf(path, cfg, quant=GGMLType.Q3_K,
+                          ffn_quant=GGMLType.Q5_K)
+    eng = Engine(path, n_ctx=64, decode_chunk=2, max_gen_tokens=4,
+                 prefill_buckets=(32, 64))
+    out = eng.create_chat_completion(
+        [{"role": "user", "content": "hi"}], temperature=0.0, max_tokens=3)
+    assert out["usage"]["completion_tokens"] >= 1
+
+
 def test_f16_file_serves_int8_decision():
     """BASELINE config #3's F16 GGUF variant: a file with no fused-eligible
     quantized tensors must resolve EXPLICITLY to int8 serving (8B bf16 can't

@@ -156,6 +156,73 @@ def scalar_dequant_q5_k(raw):
     return np.array(out, dtype=np.float32)
 
 
+def scalar_dequant_q2_k(raw):
+    # transcribed from llama.cpp dequantize_row_q2_K (explicit loops)
+    out = []
+    for blk in raw.reshape(-1, 84):
+        scales = blk[:16]
+        d = _f16(blk[80], blk[81])
+        dmin = _f16(blk[82], blk[83])
+        q_off = 16
+        is_ = 0
+        for _n in range(2):          # two 128-element halves
+            shift = 0
+            for _j in range(4):
+                sc = scales[is_]
+                is_ += 1
+                dl, ml = float(d) * (sc & 0xF), float(dmin) * (sc >> 4)
+                for l in range(16):
+                    out.append(dl * ((int(blk[q_off + l]) >> shift) & 3) - ml)
+                sc = scales[is_]
+                is_ += 1
+                dl, ml = float(d) * (sc & 0xF), float(dmin) * (sc >> 4)
+                for l in range(16):
+                    out.append(dl * ((int(blk[q_off + 16 + l]) >> shift) & 3) - ml)
+                shift += 2
+            q_off += 32
+    return np.array(out, dtype=np.float32)
+
+
+def scalar_dequant_q3_k(raw):
+    # transcribed from llama.cpp dequantize_row_q3_K, incl. the kmask aux
+    # munging done on the original aux words before reassignment
+    kmask1, kmask2 = 0x03030303, 0x0F0F0F0F
+    out = []
+    for blk in raw.reshape(-1, 110):
+        hm = blk[:32]
+        d_all = _f16(blk[108], blk[109])
+        aux = [int.from_bytes(bytes(blk[96 + 4 * i:100 + 4 * i]), "little")
+               for i in range(3)]
+        tmp = aux[2]
+        aux2 = ((aux[0] >> 4) & kmask2) | (((tmp >> 4) & kmask1) << 4)
+        aux3 = ((aux[1] >> 4) & kmask2) | (((tmp >> 6) & kmask1) << 4)
+        aux0 = (aux[0] & kmask2) | (((tmp >> 0) & kmask1) << 4)
+        aux1 = (aux[1] & kmask2) | (((tmp >> 2) & kmask1) << 4)
+        sc_bytes = b"".join(a.to_bytes(4, "little")
+                            for a in (aux0, aux1, aux2, aux3))
+        scales = np.frombuffer(sc_bytes, dtype=np.int8)
+        q_off = 32
+        m = 1
+        is_ = 0
+        for _n in range(2):
+            shift = 0
+            for _j in range(4):
+                dl = float(d_all) * (int(scales[is_]) - 32)
+                is_ += 1
+                for l in range(16):
+                    q = (int(blk[q_off + l]) >> shift) & 3
+                    out.append(dl * (q - (0 if hm[l] & m else 4)))
+                dl = float(d_all) * (int(scales[is_]) - 32)
+                is_ += 1
+                for l in range(16):
+                    q = (int(blk[q_off + 16 + l]) >> shift) & 3
+                    out.append(dl * (q - (0 if hm[16 + l] & m else 4)))
+                shift += 2
+                m <<= 1
+            q_off += 32
+    return np.array(out, dtype=np.float32)
+
+
 def scalar_dequant_q6_k(raw):
     out = []
     for blk in raw.reshape(-1, 210):
@@ -195,6 +262,11 @@ def _random_blocks(gtype: GGMLType, nb: int) -> np.ndarray:
         raw[:, 2:4] = _rand_f16_bytes(nb)
     elif gtype == GGMLType.Q6_K:
         raw[:, 208:210] = _rand_f16_bytes(nb)
+    elif gtype == GGMLType.Q2_K:
+        raw[:, 80:82] = _rand_f16_bytes(nb)
+        raw[:, 82:84] = _rand_f16_bytes(nb)
+    elif gtype == GGMLType.Q3_K:
+        raw[:, 108:110] = _rand_f16_bytes(nb)
     return raw.reshape(-1)
 
 
@@ -204,6 +276,8 @@ SCALAR = {
     GGMLType.Q4_1: scalar_dequant_q4_1,
     GGMLType.Q5_0: scalar_dequant_q5_0,
     GGMLType.Q5_1: scalar_dequant_q5_1,
+    GGMLType.Q2_K: scalar_dequant_q2_k,
+    GGMLType.Q3_K: scalar_dequant_q3_k,
     GGMLType.Q4_K: scalar_dequant_q4_k,
     GGMLType.Q5_K: scalar_dequant_q5_k,
     GGMLType.Q6_K: scalar_dequant_q6_k,
@@ -228,6 +302,8 @@ def test_dequant_matches_scalar_reference(gtype):
         (GGMLType.Q4_1, 0.15),
         (GGMLType.Q5_0, 0.10),
         (GGMLType.Q5_1, 0.08),
+        (GGMLType.Q2_K, 0.45),
+        (GGMLType.Q3_K, 0.25),
         (GGMLType.Q4_K, 0.15),
         (GGMLType.Q5_K, 0.08),
         (GGMLType.Q6_K, 0.05),
