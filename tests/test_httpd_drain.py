@@ -112,8 +112,8 @@ def _stop(holder):
     holder["loop"].call_soon_threadsafe(holder["stop"].set)
 
 
-def _raw_request(body: bytes) -> bytes:
-    return (b"POST /response HTTP/1.1\r\n"
+def _raw_request(body: bytes, path: bytes = b"/response") -> bytes:
+    return (b"POST " + path + b" HTTP/1.1\r\n"
             b"Host: x\r\nContent-Type: application/json\r\n"
             b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body)
 
@@ -184,6 +184,32 @@ def test_midupload_request_is_drained_with_connection_close():
         assert b"connection: close" in head.lower()
         assert json.loads(body)["response"] == "late ok"
         holder["thread"].join(10)
+        assert not holder["thread"].is_alive()
+    finally:
+        s.close()
+
+
+def test_stream_inflight_drains_to_done():
+    """An SSE stream mid-generation at SIGTERM drains to its [DONE]
+    terminator (chunked transfer completes) instead of being cut."""
+    port = _free_port()
+    eng = FakeEngine(reply="one two three four", chunk_delay=0.2)
+    holder = _start_server(create_app(engine=eng), port)
+    s = socket.create_connection(("127.0.0.1", port), timeout=20)
+    try:
+        s.sendall(_raw_request(PAYLOAD, path=b"/response/stream"))
+        # wait until the stream has started (first bytes arrive), then stop
+        first = s.recv(4096)
+        assert b"200" in first.split(b"\r\n", 1)[0]
+        _stop(holder)
+        buf = first
+        while b"[DONE]" not in buf:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        assert b"[DONE]" in buf, "stream was cut before its terminator"
+        holder["thread"].join(15)
         assert not holder["thread"].is_alive()
     finally:
         s.close()
