@@ -102,6 +102,9 @@ def main() -> None:
     # engine decodes one lane at a time (measured: batch=4 aggregate
     # throughput equal to a single lane's)
     os.environ["LFKT_BATCH_SIZE"] = str(batch)
+    from llama_fastapi_k8s_gpu_tpu.utils.config import get_settings
+
+    settings = get_settings()
     if batch > 1:
         # continuous batching on one chip: B slot-scheduled lanes amortize
         # every weight read over up to B decode tokens — the aggregate-
@@ -113,16 +116,20 @@ def main() -> None:
             params, cfg, tok, template_kind="llama3",
             max_gen_tokens=max_tokens, attn_impl=cfg.attn_impl,
             dp=1, batch_size=batch,
+            # honor the same LFKT_* scheduler knobs the production factory
+            # does (server/app.py passes each from Settings) — a
+            # directly-constructed engine otherwise pins constructor
+            # defaults and an env A/B silently measures the same arm
+            # twice (the round-4 lane-prefix lesson).
+            decode_chunk=settings.decode_chunk,
+            adm_budget=settings.adm_budget,
             spec_decode=spec_decode, spec_draft=spec_draft,
-            # the lane-prefix A/B knobs (VERDICT r4 #8): without explicit
-            # plumbing the envs would be read by Settings only, and this
-            # bench builds its engine directly — the +prefix arm would
-            # silently measure the reuse-free scheduler again.  The
-            # admission slice size matters to the A/B too: reuse is
-            # chunk-aligned, so a 256-token slice needs 256 shared tokens
-            # before the first claim pays.
+            # the lane-prefix A/B knobs (VERDICT r4 #8).  The admission
+            # slice size matters to the A/B too: reuse is chunk-aligned,
+            # so a 256-token slice needs 256 shared tokens before the
+            # first claim pays.
             lane_prefix_cache=lane_prefix,
-            prefill_chunk=int(os.environ.get("LFKT_PREFILL_CHUNK", "256")))
+            prefill_chunk=settings.prefill_chunk)
         # report the engine's REALIZED setting, not the env request: spec
         # decode silently excludes lane-prefix reuse (continuous.py), and a
         # ',laneprefix'-labeled artifact with reuse actually off would be a
@@ -138,6 +145,7 @@ def main() -> None:
         eng = Engine.from_parts(params, cfg, tok, template_kind="llama3",
                                 max_gen_tokens=max_tokens,
                                 attn_impl=cfg.attn_impl,
+                                decode_chunk=settings.decode_chunk,
                                 spec_decode=spec_decode,
                                 spec_draft=spec_draft,
                                 prefix_cache=multiturn)
@@ -359,6 +367,7 @@ def main() -> None:
                                    if follow else None),
             "turn1_ttft_ms_p50": round(pq(turn1, 0.5), 1) if turn1 else None,
             "follow_samples": len(follow),
+            "decode_chunk": settings.decode_chunk,
             "conversations": batch,
             "turns": turns,
             "turns_completed": sorted(completed),
@@ -458,6 +467,7 @@ def main() -> None:
             "turns": n_req,
             "turns_measured": len(per_turn),
             "stream_errors": mt_errors,
+            "decode_chunk": settings.decode_chunk,
             "max_tokens": max_tokens,
             "warmup_s": round(warm_s, 1),
             "prefix_cache": counters,
@@ -556,6 +566,8 @@ def main() -> None:
                    + (",fullctx" if fullctx else "")
                    + (",spec" if spec_decode == "lookup" else "")
                    + (",laneprefix" if lane_prefix and batch > 1 else "")
+                   + (f",chunk{settings.decode_chunk}"
+                      if settings.decode_chunk != 8 else "")
                    + (f",batch{batch}]" if batch > 1 else "]")),
         "value": round(p(ttft, 0.5), 1),
         "unit": "ms",
@@ -563,6 +575,7 @@ def main() -> None:
         "ttft_ms_p95_server": round(p(ttft, 0.95), 1),
         "latency_ms_p50": round(p(lat, 0.5), 1),
         "latency_ms_p95": round(p(lat, 0.95), 1),
+        "decode_chunk": settings.decode_chunk,
         "max_tokens": max_tokens,
         "n_requests": n_req,
         "warmup_s": round(warm_s, 1),
