@@ -102,7 +102,7 @@ def main() -> None:
     # engine decodes one lane at a time (measured: batch=4 aggregate
     # throughput equal to a single lane's)
     os.environ["LFKT_BATCH_SIZE"] = str(batch)
-    from llama_fastapi_k8s_gpu_tpu.utils.config import get_settings
+    from llama_fastapi_k8s_gpu_tpu.utils.config import Settings, get_settings
 
     settings = get_settings()
     if batch > 1:
@@ -567,7 +567,8 @@ def main() -> None:
                    + (",spec" if spec_decode == "lookup" else "")
                    + (",laneprefix" if lane_prefix and batch > 1 else "")
                    + (f",chunk{settings.decode_chunk}"
-                      if settings.decode_chunk != 8 else "")
+                      if settings.decode_chunk != Settings.decode_chunk
+                      else "")
                    + (f",batch{batch}]" if batch > 1 else "]")),
         "value": round(p(ttft, 0.5), 1),
         "unit": "ms",

@@ -258,6 +258,87 @@ def pack_scale_min_k4(sc: np.ndarray, mn: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# IQ4_NL / IQ4_XS — non-linear 4-bit: indices into a fixed 16-value LUT
+# (llama.cpp kvalues_iq4nl).  IQ4_NL: block 32 = f16 d | 16B nibble
+# indices.  IQ4_XS: super-block 256 = f16 d | u16 scales_h | 4B scales_l |
+# 128B qs; 8 sub-blocks of 32 with 6-bit scales (ls − 32), low nibbles →
+# elements 0..15 of the sub-block, high → 16..31.
+# ---------------------------------------------------------------------------
+
+KVALUES_IQ4NL = np.array(
+    [-127, -104, -83, -65, -49, -35, -22, -10,
+     1, 13, 25, 38, 53, 69, 89, 113], dtype=np.float32)
+
+
+def dequant_iq4_nl(buf: np.ndarray, n: int) -> np.ndarray:
+    nb = n // 32
+    blocks = buf[: nb * 18].reshape(nb, 18)
+    d = _f16(blocks[:, 0:2].reshape(-1))
+    qs = blocks[:, 2:]
+    lo = KVALUES_IQ4NL[qs & 0x0F]
+    hi = KVALUES_IQ4NL[qs >> 4]
+    return (d[:, None] * np.concatenate([lo, hi], axis=1)).reshape(-1)
+
+
+def _nearest_iq4nl(x: np.ndarray) -> np.ndarray:
+    """Values → nearest-LUT 4-bit indices (any shape)."""
+    return np.abs(x[..., None] - KVALUES_IQ4NL).argmin(axis=-1).astype(np.uint8)
+
+
+def quant_iq4_nl(x: np.ndarray) -> np.ndarray:
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1, 32)
+    amax = np.abs(x).max(axis=1)
+    d = (amax / 113.0).astype(np.float16)    # map the peak onto ±113
+    inv = np.where(d > 0, 1.0 / d.astype(np.float32), 0.0)
+    q = _nearest_iq4nl(x * inv[:, None])
+    out = np.empty((x.shape[0], 18), dtype=np.uint8)
+    out[:, 0:2] = d.view(np.uint8).reshape(-1, 2)
+    out[:, 2:] = q[:, :16] | (q[:, 16:] << 4)
+    return out.reshape(-1)
+
+
+def dequant_iq4_xs(buf: np.ndarray, n: int) -> np.ndarray:
+    nb = n // QK_K
+    bs = GGML_BLOCK_SIZES[GGMLType.IQ4_XS][1]  # 136
+    blocks = buf[: nb * bs].reshape(nb, bs)
+    d = _f16(blocks[:, 0:2].reshape(-1))
+    scales_h = blocks[:, 2:4].copy().view(np.uint16).reshape(-1)  # (nb,)
+    scales_l = blocks[:, 4:8]
+    qs = blocks[:, 8:].reshape(nb, 8, 16)
+    ib = np.arange(8)
+    ls = (((scales_l[:, ib // 2] >> (4 * (ib % 2))) & 0x0F)
+          | (((scales_h[:, None] >> (2 * ib)) & 3) << 4)).astype(np.float32)
+    dl = d[:, None] * (ls - 32.0)                               # (nb, 8)
+    lo = KVALUES_IQ4NL[qs & 0x0F]                               # (nb, 8, 16)
+    hi = KVALUES_IQ4NL[qs >> 4]
+    y = dl[:, :, None] * np.concatenate([lo, hi], axis=2)       # (nb, 8, 32)
+    return y.reshape(-1)
+
+
+def quant_iq4_xs(x: np.ndarray) -> np.ndarray:
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1, QK_K)
+    nb = x.shape[0]
+    sub = x.reshape(nb, 8, 32)
+    dl_sub = np.abs(sub).max(axis=2) / 113.0                    # ≥ 0
+    mx = dl_sub.max(axis=1)
+    d = np.where(mx > 0, mx / 31.0, 0.0).astype(np.float16)     # ls−32 ≤ 31
+    invd = np.where(d > 0, 1.0 / d.astype(np.float32), 0.0)
+    ls = np.clip(np.round(dl_sub * invd[:, None]) + 32, 0, 63).astype(np.uint8)
+    dl_q = d.astype(np.float32)[:, None] * (ls.astype(np.float32) - 32.0)
+    inv_dl = np.where(dl_q != 0, 1.0 / dl_q, 0.0)
+    q = _nearest_iq4nl(sub * inv_dl[:, :, None])                # (nb, 8, 32)
+    out = np.empty((nb, 136), dtype=np.uint8)
+    out[:, 0:2] = d.view(np.uint8).reshape(-1, 2)
+    ib = np.arange(8)
+    sh = ((ls >> 4).astype(np.uint32) << (2 * ib)).sum(axis=1).astype(np.uint16)
+    out[:, 2:4] = sh.view(np.uint8).reshape(nb, 2)
+    low = ls & 0x0F
+    out[:, 4:8] = low[:, 0::2] | (low[:, 1::2] << 4)
+    out[:, 8:] = (q[:, :, :16] | (q[:, :, 16:] << 4)).reshape(nb, 128)
+    return out.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
 # Q2_K — 16 sub-blocks of 16; 4-bit scale + 4-bit min per sub-block,
 # superblock f16 d/dmin; 2-bit quants.  Layout per llama.cpp block_q2_K:
 # scales[16] | qs[64] | d | dmin (84 B).  Element order: two 128-halves;
@@ -612,6 +693,8 @@ DEQUANT = {
     GGMLType.Q4_K: dequant_q4_k,
     GGMLType.Q5_K: dequant_q5_k,
     GGMLType.Q6_K: dequant_q6_k,
+    GGMLType.IQ4_NL: dequant_iq4_nl,
+    GGMLType.IQ4_XS: dequant_iq4_xs,
 }
 
 QUANT = {
@@ -628,6 +711,8 @@ QUANT = {
     GGMLType.Q4_K: quant_q4_k,
     GGMLType.Q5_K: quant_q5_k,
     GGMLType.Q6_K: quant_q6_k,
+    GGMLType.IQ4_NL: quant_iq4_nl,
+    GGMLType.IQ4_XS: quant_iq4_xs,
 }
 
 

@@ -187,6 +187,24 @@ def test_q2k_q3k_files_load_and_serve(tmp_path):
     assert out["usage"]["completion_tokens"] >= 1
 
 
+def test_iq4_files_load_and_serve(tmp_path):
+    """IQ4_NL / IQ4_XS GGUFs (the modern non-linear 4-bit formats) load
+    through the int8 requant path and serve."""
+    from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig(vocab_size=263, dim=256, n_layers=1, n_heads=4,
+                      n_kv_heads=2, ffn_dim=256, n_ctx=64, rope_theta=1e4)
+    for gtype in (GGMLType.IQ4_NL, GGMLType.IQ4_XS):
+        path = str(tmp_path / f"{gtype.name.lower()}.gguf")
+        write_tiny_llama_gguf(path, cfg, quant=gtype, ffn_quant=gtype)
+        eng = Engine(path, n_ctx=64, decode_chunk=2, max_gen_tokens=4,
+                     prefill_buckets=(32, 64), weight_format="int8")
+        out = eng.create_chat_completion(
+            [{"role": "user", "content": "hi"}], temperature=0.0,
+            max_tokens=3)
+        assert out["usage"]["completion_tokens"] >= 1, gtype.name
+
+
 def test_f16_file_serves_int8_decision():
     """BASELINE config #3's F16 GGUF variant: a file with no fused-eligible
     quantized tensors must resolve EXPLICITLY to int8 serving (8B bf16 can't

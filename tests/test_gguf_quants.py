@@ -156,6 +156,42 @@ def scalar_dequant_q5_k(raw):
     return np.array(out, dtype=np.float32)
 
 
+_KV_IQ4NL = [-127, -104, -83, -65, -49, -35, -22, -10,
+             1, 13, 25, 38, 53, 69, 89, 113]
+
+
+def scalar_dequant_iq4_nl(raw):
+    out = []
+    for blk in raw.reshape(-1, 18):
+        d = _f16(blk[0], blk[1])
+        qs = blk[2:]
+        vals = [0.0] * 32
+        for j in range(16):
+            vals[j] = float(d) * _KV_IQ4NL[int(qs[j]) & 0x0F]
+            vals[j + 16] = float(d) * _KV_IQ4NL[int(qs[j]) >> 4]
+        out.extend(vals)
+    return np.array(out, dtype=np.float32)
+
+
+def scalar_dequant_iq4_xs(raw):
+    # transcribed from llama.cpp dequantize_row_iq4_xs
+    out = []
+    for blk in raw.reshape(-1, 136):
+        d = _f16(blk[0], blk[1])
+        scales_h = int(blk[2]) | (int(blk[3]) << 8)
+        scales_l = blk[4:8]
+        qs = blk[8:]
+        for ib in range(8):
+            ls = (((int(scales_l[ib // 2]) >> (4 * (ib % 2))) & 0xF)
+                  | (((scales_h >> (2 * ib)) & 3) << 4))
+            dl = float(d) * (ls - 32)
+            for j in range(16):
+                out.append(dl * _KV_IQ4NL[int(qs[16 * ib + j]) & 0x0F])
+            for j in range(16):
+                out.append(dl * _KV_IQ4NL[int(qs[16 * ib + j]) >> 4])
+    return np.array(out, dtype=np.float32)
+
+
 def scalar_dequant_q2_k(raw):
     # transcribed from llama.cpp dequantize_row_q2_K (explicit loops)
     out = []
@@ -254,7 +290,8 @@ def _random_blocks(gtype: GGMLType, nb: int) -> np.ndarray:
     """Random valid raw blocks: random payload bytes, sane f16 scales."""
     _, bsize = GGML_BLOCK_SIZES[gtype]
     raw = rng.integers(0, 256, size=(nb, bsize), dtype=np.uint8)
-    if gtype in (GGMLType.Q8_0, GGMLType.Q4_0, GGMLType.Q5_0):
+    if gtype in (GGMLType.Q8_0, GGMLType.Q4_0, GGMLType.Q5_0,
+                 GGMLType.IQ4_NL, GGMLType.IQ4_XS):
         raw[:, 0:2] = _rand_f16_bytes(nb)
     elif gtype in (GGMLType.Q4_K, GGMLType.Q5_K, GGMLType.Q4_1,
                    GGMLType.Q5_1):
@@ -281,6 +318,8 @@ SCALAR = {
     GGMLType.Q4_K: scalar_dequant_q4_k,
     GGMLType.Q5_K: scalar_dequant_q5_k,
     GGMLType.Q6_K: scalar_dequant_q6_k,
+    GGMLType.IQ4_NL: scalar_dequant_iq4_nl,
+    GGMLType.IQ4_XS: scalar_dequant_iq4_xs,
 }
 
 
@@ -307,6 +346,8 @@ def test_dequant_matches_scalar_reference(gtype):
         (GGMLType.Q4_K, 0.15),
         (GGMLType.Q5_K, 0.08),
         (GGMLType.Q6_K, 0.05),
+        (GGMLType.IQ4_NL, 0.15),
+        (GGMLType.IQ4_XS, 0.15),
     ],
 )
 def test_quant_roundtrip_error(gtype, rel_bound):
