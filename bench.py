@@ -411,11 +411,9 @@ def child_main() -> None:
     import numpy as np
     import jax.numpy as jnp
 
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        # a site hook may pre-register the tunneled device platform and
-        # override the env var at startup; the post-import config update wins
-        # if no backend is initialized yet (same defense as tests/conftest.py)
-        jax.config.update("jax_platforms", "cpu")
+    from llama_fastapi_k8s_gpu_tpu.utils.config import force_cpu_if_requested
+
+    force_cpu_if_requested()   # site-hook defense (one copy: utils/config)
 
     from llama_fastapi_k8s_gpu_tpu.utils.jaxcache import setup_compile_cache
 

@@ -25,15 +25,9 @@ def main():
             "load-bearing (a TPU chip admits a single claimant; the model "
             "loads once per process). Scale concurrency with "
             "LFKT_BATCH_SIZE lanes on one chip, or replicas across chips.")
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        # a site hook may pre-register a device platform and override the
-        # env var at startup; the post-import config update wins if no
-        # backend is initialized yet (same defense as tests/conftest.py
-        # and bench.py — without it, JAX_PLATFORMS=cpu silently attaches
-        # to the accelerator anyway)
-        import jax
+    from ..utils.config import force_cpu_if_requested
 
-        jax.config.update("jax_platforms", "cpu")
+    force_cpu_if_requested()   # site-hook defense (one copy: utils/config)
     host = os.environ.get("LFKT_HOST", "0.0.0.0")
     port = int(os.environ.get("LFKT_PORT", "8000"))
     try:

@@ -23,6 +23,25 @@ def _env(name: str, default, cast=str):
     return cast(raw)
 
 
+def force_cpu_if_requested() -> bool:
+    """THE site-hook defense (one copy): when the caller asked for the CPU
+    backend (``JAX_PLATFORMS=cpu``) but a site hook may have pre-registered
+    the tunneled device platform and overridden the env var, re-pin the
+    platform via ``jax.config`` — which wins while no backend is
+    initialized.  Without this, a "CPU" test/dryrun silently attaches to
+    the single-session accelerator and can hold its claim (observed
+    2026-07-31 and again 2026-08-01).  Call BEFORE the first
+    ``jax.devices()``/computation; returns True when the pin was applied.
+    Callers: tests/conftest.py, __graft_entry__.py, server/__main__.py,
+    bench.py."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True
+
+
 def env_bool(name: str, default: bool = False) -> bool:
     """THE truthy-env convention (one parser: '1'/'true'/'yes'/'on').
     Direct-engine-construction paths (bench_server.py, models/params.py)
