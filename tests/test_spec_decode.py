@@ -207,6 +207,35 @@ def test_spec_timings_report_acceptance(tmp_path):
     assert 0 <= st["accepted"] <= st["drafted"]
 
 
+def test_spec_realized_acceptance_on_repetitive_generation(tmp_path):
+    """Existence proof that ORGANIC prompt-lookup speculation pays on
+    repetitive content through the production path (no monkeypatched
+    drafts): greedy decoding on a tiny random model falls into
+    repetition, the n-gram heuristic finds it, and the verify forward
+    ACCEPTS drafted tokens — while the output stays identical to the
+    vanilla path.  This is the realized-acceptance evidence the
+    synthetic sampled-temperature benches structurally cannot produce
+    (random sampled text never repeats; docs/PERF.md 'Speculative
+    decoding')."""
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    plain = Engine(path, n_ctx=256, decode_chunk=4, max_gen_tokens=96,
+                   prefill_buckets=(64,))
+    spec = Engine(path, n_ctx=256, decode_chunk=4, max_gen_tokens=96,
+                  prefill_buckets=(64,), spec_decode="lookup", spec_draft=4)
+    msgs = [{"role": "user", "content": "repeat after me: the cat sat"}]
+    a = plain.create_chat_completion(msgs, temperature=0.0, max_tokens=96,
+                                     seed=0)
+    b = spec.create_chat_completion(msgs, temperature=0.0, max_tokens=96,
+                                    seed=0)
+    assert a["choices"][0]["message"]["content"] == \
+        b["choices"][0]["message"]["content"]
+    st = b["lfkt_timings"]["spec"]
+    assert st["accepted"] > 0, st
+    # several tokens per weight read on average when drafts fire
+    assert st["accepted"] >= st["verify_steps"], st
+
+
 # ---------------------------------------------------------------------------
 # continuous scheduler: per-lane drafts + batched verify (VERDICT r3 #7)
 # ---------------------------------------------------------------------------
