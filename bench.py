@@ -52,7 +52,7 @@ _INIT_MARK = "LFKT_INIT_OK"
 #: leaf key that marks a fused-layout weight dict per bench format — the
 #: label-honesty check (report the fused format only if any tensor actually
 #: got the layout).  Shared with bench_server.py.
-FUSED_KEYS = {"q4k": "qs", "q8": "q8", "q4km": "qs"}
+FUSED_KEYS = {"q4k": "qs", "q8": "q8", "q4km": "qs", "q5km": "q5s"}
 
 
 def probe_fused_or_degrade(wfmt: str, tag: str):
@@ -62,12 +62,14 @@ def probe_fused_or_degrade(wfmt: str, tag: str):
     so the two benches can't diverge in what they degrade."""
     from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import (
         probe_fused_q4k,
+        probe_fused_q5k,
         probe_fused_q6k,
         probe_fused_q8,
     )
 
     probes = {"q4k": [probe_fused_q4k], "q8": [probe_fused_q8],
-              "q4km": [probe_fused_q4k, probe_fused_q6k]}
+              "q4km": [probe_fused_q4k, probe_fused_q6k],
+              "q5km": [probe_fused_q5k, probe_fused_q6k]}
     for pr in probes.get(wfmt, []):
         err = pr()
         if err is not None:
@@ -132,7 +134,8 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
     B/weight.  ``fmt="q4km"``: the Q4_K_M tensor-type mix — fused Q6_K for
     ``attn_v``/``ffn_down``/``output`` (~0.88 B/w), fused Q4_K for the rest
     (~0.63 B/w) — mirroring coldstart_main's file writer (the repo's
-    file-fidelity definition).  Slightly conservative vs a genuine
+    file-fidelity definition).  ``fmt="q5km"``: the Q5_K_M analogue —
+    the same Q6_K tensors plus fused Q5_K for the rest (~0.75 B/w).  Slightly conservative vs a genuine
     llama.cpp artifact, whose ``use_more_bits`` recipe puts only about
     half the ffn_down layers on Q6_K (~5% fewer HBM bytes/token than this
     grid); a real Q4_K_M file (reference api.py:14) serves at or above
@@ -152,6 +155,19 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
         want = want or fmt
         if want == "q4km":
             want = "q4k"
+        if want == "q5km":
+            want = "q5k"
+        if want == "q5k" and q4k_compatible(out_dim, in_dim, for_tpu=True):
+            # fused Q5_K layout (ops/pallas/q5matmul.py): combined-nibble
+            # plane + high-bit plane + lane-tiled scales, ~0.75 B/weight
+            k1, k2 = jax.random.split(k)
+            q5s = jax.random.randint(k1, (L, out_dim, in_dim // 2),
+                                     -128, 128, jnp.int8)
+            q5h = jax.random.randint(k2, (L, out_dim, in_dim // 8),
+                                     -128, 128, jnp.int8)
+            sm5 = jnp.full((L, in_dim // TK, out_dim, 128),
+                           (in_dim ** -0.5) / 16.0, jnp.bfloat16)
+            return {"q5s": q5s, "q5h": q5h, "sm5": sm5}
         if want == "q4k" and q4k_compatible(out_dim, in_dim, for_tpu=True):
             qs = jax.random.randint(k, (L, out_dim, in_dim // 2),
                                     -128, 128, jnp.int8)
@@ -177,9 +193,10 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
         s = jnp.full((L, out_dim), (in_dim ** -0.5) / 127.0, jnp.float32)
         return {"q": q, "s": s}
 
-    # Q4_K_M per-name type map: attn_v, ffn_down and the output head ride
-    # Q6_K, everything else Q4_K (mirrors coldstart_main's file writer)
-    q6 = "q6k" if fmt == "q4km" else None
+    # Q4_K_M / Q5_K_M per-name type map: attn_v, ffn_down and the output
+    # head ride Q6_K, everything else Q4_K resp. Q5_K (llama.cpp's
+    # use_more_bits recipe; mirrors coldstart_main's file writer)
+    q6 = "q6k" if fmt in ("q4km", "q5km") else None
 
     ks = jax.random.split(key, 8)
     emb = (jax.random.normal(ks[0], (cfg.vocab_size, cfg.dim), jnp.bfloat16)
@@ -217,8 +234,9 @@ def _synth_output_head(cfg, fmt: str, key):
             "sm": jnp.full((cfg.dim // TK, cfg.vocab_size, 128),
                            (cfg.dim ** -0.5) / 8.0, jnp.bfloat16),
         }
-    if fmt == "q4km" and q4k_compatible(cfg.vocab_size, cfg.dim, for_tpu=True):
-        # Q4_K_M files store output.weight as Q6_K (coldstart_main writer)
+    if (fmt in ("q4km", "q5km")
+            and q4k_compatible(cfg.vocab_size, cfg.dim, for_tpu=True)):
+        # Q4_K_M / Q5_K_M files store output.weight as Q6_K
         k1, k2 = jax.random.split(key)
         return {
             "q4": jax.random.randint(k1, (cfg.vocab_size, cfg.dim // 2),
@@ -458,7 +476,8 @@ def child_main() -> None:
     # reference api.py:14) and the Pallas flash prefill that
     # engine.Engine(attn_impl="auto") resolves to on TPU with head_dim 128.
     preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
-    # q4km (file-fidelity Q4_K_M mix, the headline) | q4k | q8 | int8 | f16
+    # q4km (file-fidelity Q4_K_M mix, the headline) | q5km (Q5_K_M mix)
+    # | q4k | q8 | int8 | f16
     wfmt = os.environ.get("LFKT_BENCH_FMT", "q4km")
     fmt_label = wfmt
     if wfmt == "f16":
