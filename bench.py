@@ -52,7 +52,10 @@ _INIT_MARK = "LFKT_INIT_OK"
 #: leaf key that marks a fused-layout weight dict per bench format — the
 #: label-honesty check (report the fused format only if any tensor actually
 #: got the layout).  Shared with bench_server.py.
-FUSED_KEYS = {"q4k": "qs", "q8": "q8", "q4km": "qs", "q5km": "q5s"}
+#: any ONE of the listed leaf keys marks the format's fused layout
+#: (q5km has two because `pre` is a LAYOUT variant: q5s split / q5p plane)
+FUSED_KEYS = {"q4k": ("qs",), "q8": ("q8",), "q4km": ("qs",),
+              "q5km": ("q5s", "q5p")}
 
 
 def probe_fused_or_degrade(wfmt: str, tag: str):
@@ -159,14 +162,27 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
             want = "q5k"
         if want == "q5k" and q4k_compatible(out_dim, in_dim, for_tpu=True):
             # fused Q5_K layout (ops/pallas/q5matmul.py): combined-nibble
-            # plane + high-bit plane + lane-tiled scales, ~0.75 B/weight
+            # plane + high-bit plane + lane-tiled scales, ~0.75 B/weight.
+            # LAYOUT variants must be honored here too — the kernels
+            # dispatch on plane presence, so a synthetic split grid under
+            # LFKT_Q5K_KERNEL=pre would silently A/B the split path
+            # against itself (the hollow-A/B trap).
+            from llama_fastapi_k8s_gpu_tpu.ops.pallas.q5matmul import (
+                Q5K_VARIANTS,
+                _env_variant,
+            )
+
+            sm5 = jnp.full((L, in_dim // TK, out_dim, 128),
+                           (in_dim ** -0.5) / 16.0, jnp.bfloat16)
+            if _env_variant("LFKT_Q5K_KERNEL", Q5K_VARIANTS) == "pre":
+                q5p = jax.random.randint(k, (L, out_dim, in_dim),
+                                         0, 32, jnp.int8)
+                return {"q5p": q5p, "sm5": sm5}
             k1, k2 = jax.random.split(k)
             q5s = jax.random.randint(k1, (L, out_dim, in_dim // 2),
                                      -128, 128, jnp.int8)
             q5h = jax.random.randint(k2, (L, out_dim, in_dim // 8),
                                      -128, 128, jnp.int8)
-            sm5 = jnp.full((L, in_dim // TK, out_dim, 128),
-                           (in_dim ** -0.5) / 16.0, jnp.bfloat16)
             return {"q5s": q5s, "q5h": q5h, "sm5": sm5}
         if want == "q4k" and q4k_compatible(out_dim, in_dim, for_tpu=True):
             qs = jax.random.randint(k, (L, out_dim, in_dim // 2),
@@ -552,7 +568,7 @@ def child_main() -> None:
     # got the layout (tiny shapes fall back to int8)
     fused_key = FUSED_KEYS.get(wfmt)
     if fused_key is not None and not any(
-            isinstance(v, dict) and fused_key in v
+            isinstance(v, dict) and any(fk in v for fk in fused_key)
             for v in [*params["layers"].values(), params["output"]]):
         wfmt = fmt_label = "int8"
     # sync: reduce EVERY leaf to a scalar and fetch it (block_until_ready is

@@ -92,7 +92,7 @@ def main() -> None:
     params = synth_params_device(cfg, fmt=wfmt)
     fused_key = FUSED_KEYS.get(wfmt)
     if fused_key is not None and not any(
-            isinstance(v, dict) and fused_key in v
+            isinstance(v, dict) and any(fk in v for fk in fused_key)
             for v in [*params["layers"].values(), params["output"]]):
         wfmt = "int8"  # label honesty: tiny shapes fall back
     batch = int(os.environ.get("LFKT_BENCH_BATCH", "1"))

@@ -106,7 +106,7 @@ def _fused_fns(w: dict):
         from .pallas import q6matmul as m
 
         return m.q6k_matmul, m.q6k_matmul_stacked
-    if "q5s" in w:
+    if "q5s" in w or "q5p" in w:  # split or `pre` Q5_K layout
         from .pallas import q5matmul as m
 
         return m.q5k_matmul, m.q5k_matmul_stacked

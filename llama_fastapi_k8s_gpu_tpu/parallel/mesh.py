@@ -67,6 +67,7 @@ def _linear_sharding(mesh: Mesh, col_parallel: bool) -> dict:
         "sm": _ns(mesh, None, None, "tp", None),
         "q5s": _ns(mesh, None, "tp", None),
         "q5h": _ns(mesh, None, "tp", None),
+        "q5p": _ns(mesh, None, "tp", None),
         "sm5": _ns(mesh, None, None, "tp", None),
         "q4": _ns(mesh, None, "tp", None),
         "q2": _ns(mesh, None, "tp", None),
@@ -108,6 +109,7 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
             "s": _ns(mesh, "tp"), "qs": _ns(mesh, "tp", None),
             "sm": _ns(mesh, None, "tp", None),
             "q5s": _ns(mesh, "tp", None), "q5h": _ns(mesh, "tp", None),
+            "q5p": _ns(mesh, "tp", None),
             "sm5": _ns(mesh, None, "tp", None),
             "q4": _ns(mesh, "tp", None), "q2": _ns(mesh, "tp", None),
             "q6p": _ns(mesh, "tp", None),
@@ -171,7 +173,7 @@ def _fit_sharding(arr, ns: NamedSharding) -> NamedSharding:
 # layout → main leaf (the plane whose N dim decides the whole group's fit);
 # "q6p" is the Q6_K `pre` layout's single combined plane
 _FUSED_MAIN_KEY = {"qs": "qs", "q4": "q4", "q6p": "q6p",
-                   "q5s": "q5s", "q8": "q8"}
+                   "q5s": "q5s", "q5p": "q5p", "q8": "q8"}
 
 
 def _fused_key(p: dict) -> str | None:

@@ -558,6 +558,7 @@ def create_app(engine=None, settings: Settings | None = None,
             params = getattr(eng, "params", None)
             if isinstance(params, dict) and "layers" in params:
                 kinds = {"qs": "q4k-fused", "q5s": "q5k-fused",
+                         "q5p": "q5k-fused-pre",
                          "q4": "q6k-fused", "q6p": "q6k-fused-pre",
                          "q8": "q8-fused", "q": "int8", "w": "bf16"}
                 fmt = {

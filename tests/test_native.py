@@ -156,6 +156,11 @@ def test_prep_bit_exact(monkeypatch, kind, raw_kind, n, k):
     sign-preserving quiet-NaN canonicalization against XLA's cast)."""
     import llama_fastapi_k8s_gpu_tpu.native as native_mod
 
+    # the C++ packers' contract is the SPLIT planes; prep_* may layer a
+    # `pre` combined-plane layout on top under its env default (Q5_K since
+    # the 2026-08-01 A/B), so pin the split layout for the comparison
+    monkeypatch.setenv("LFKT_Q5K_KERNEL", "cur")
+    monkeypatch.setenv("LFKT_Q6K_KERNEL", "cur")
     module, ref_name, nat_name, codec, gtype = _packer_case(kind)
     rng = np.random.default_rng(hash((kind, raw_kind, n, k)) % 2**32)
     if raw_kind == "codec":

@@ -94,7 +94,8 @@ def test_load_params_q5km_fuses(tmp_path):
                                 ffn_quant=GGMLType.Q6_K)
     gf = GGUFFile(path)
     params = load_params(gf, cfg, fmt="q4k", on_device=False)
-    assert "q5s" in params["layers"]["wq"]
+    # the shipped Q5_K default is the `pre` LAYOUT (2026-08-01 A/B)
+    assert "q5p" in params["layers"]["wq"]
     assert "q4" in params["layers"]["w_gate"]
 
     ref = load_params(gf, cfg, fmt="bf16", on_device=False)
@@ -124,10 +125,97 @@ def test_parfloor_variant_bit_identical(monkeypatch):
     rng = np.random.default_rng(2)
     n, k = 64, 2048
     w = (rng.standard_normal((n, k)) * 0.05).astype(np.float32)
+    # pin the SPLIT layout explicitly: the shipped default is the `pre`
+    # LAYOUT since the 2026-08-01 A/B, and a default-prepped q5p plane
+    # would make this split-kernel body comparison vacuous
+    monkeypatch.setenv("LFKT_Q5K_KERNEL", "cur")
     wd = prep_q5k(quant_q5_k(w.reshape(-1)), n, k)
     x = jnp.asarray(rng.standard_normal((4, k)), jnp.bfloat16)
-    monkeypatch.delenv("LFKT_Q5K_KERNEL", raising=False)
     a = np.asarray(q5k_matmul(x, wd, interpret=True))
     monkeypatch.setenv("LFKT_Q5K_KERNEL", "parfloor")
     b = np.asarray(q5k_matmul(x, wd, interpret=True))
     assert np.array_equal(a, b)
+
+
+def test_pre_layout_matches_oracle_and_split(monkeypatch):
+    """LFKT_Q5K_KERNEL=pre (pre-combined int8 q5 plane, ~3 VPU ops/weight)
+    must agree with the f32 dequant oracle at least as tightly as the
+    split `cur` path: its plane q5*sc is the exact f32 value the split
+    path reaches via l*sc + hb*(16 sc) before the same bf16 cast, and it
+    ROUNDS ONE FEWER corr term (the +8 hi-nibble bias rides the exact
+    plane instead of a bf16 corr column)."""
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas import q5matmul as qm
+
+    rng = np.random.default_rng(21)
+    n, k = 64, 4096
+    raw = quant_q5_k(_rand_weights(rng, n, k).reshape(-1))
+    monkeypatch.setenv("LFKT_Q5K_KERNEL", "cur")
+    w_split = prep_q5k(raw, n, k)
+    monkeypatch.setenv("LFKT_Q5K_KERNEL", "pre")
+    w_pre = prep_q5k(raw, n, k)
+    assert set(w_pre) == {"q5p", "sm5"}
+    assert w_pre["q5p"].dtype == jnp.int8
+    q5p = np.asarray(w_pre["q5p"])
+    assert q5p.min() >= 0 and q5p.max() < 32
+
+    x = jnp.asarray(rng.standard_normal((4, k)), jnp.float32)
+    ref = np.asarray(
+        permute_x(x).astype(jnp.bfloat16).astype(jnp.float32)
+        @ dequant_ref5(w_split).T)
+    got_pre = np.asarray(q5k_matmul(x, w_pre, interpret=True))
+    monkeypatch.setenv("LFKT_Q5K_KERNEL", "cur")
+    got_cur = np.asarray(q5k_matmul(x, w_split, interpret=True))
+
+    scale = np.abs(ref).max()
+    err_pre = np.abs(got_pre - ref).max()
+    err_cur = np.abs(got_cur - ref).max()
+    # pre rounds a strict subset of cur's terms; allow bf16-noise slack
+    assert err_pre <= err_cur + 2e-3 * scale, (err_pre, err_cur, scale)
+    np.testing.assert_allclose(got_pre, got_cur, atol=4e-3 * scale)
+
+
+def test_pre_layout_stacked_matches_plain(monkeypatch):
+    """Stacked scalar-prefetch path == plain path for the pre layout."""
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.q5matmul import (
+        q5k_matmul_stacked,
+    )
+
+    rng = np.random.default_rng(22)
+    n, k = 32, 2048
+    monkeypatch.setenv("LFKT_Q5K_KERNEL", "pre")
+    w0 = prep_q5k(quant_q5_k(_rand_weights(rng, n, k).reshape(-1)), n, k)
+    w1 = prep_q5k(quant_q5_k(_rand_weights(rng, n, k).reshape(-1)), n, k)
+    ws = {key: jnp.stack([w0[key], w1[key]]) for key in w0}
+    x = jnp.asarray(rng.standard_normal((2, k)), jnp.bfloat16)
+    for i, w in enumerate((w0, w1)):
+        plain = np.asarray(q5k_matmul(x, w, interpret=True))
+        stacked = np.asarray(q5k_matmul_stacked(x, ws, i, interpret=True))
+        np.testing.assert_array_equal(plain, stacked)
+
+
+def test_pre_layout_shards_on_mesh(monkeypatch):
+    """The q5p plane must ride the full shard_params path: tp over N when
+    the per-shard N keeps the kernel tiling, whole-leaf replication when
+    it would not (same contract as the q6p test in test_q6matmul.py)."""
+    from llama_fastapi_k8s_gpu_tpu.parallel.mesh import (
+        make_mesh, param_shardings, shard_params,
+    )
+
+    rng = np.random.default_rng(23)
+    monkeypatch.setenv("LFKT_Q5K_KERNEL", "pre")
+    n, k = 256, 2048
+    w = prep_q5k(quant_q5_k(_rand_weights(rng, n, k).reshape(-1)), n, k)
+    ws = {key: jnp.stack([w[key], w[key]]) for key in w}
+    n_bad = 24                      # 24/tp=12, not a multiple of gran=8
+    w_bad = prep_q5k(
+        quant_q5_k(_rand_weights(rng, n_bad, k).reshape(-1)), n_bad, k)
+    params = {"tok_emb": jnp.zeros((8, 8)), "out_norm": jnp.zeros((8,)),
+              "layers": {"w_down": ws, "attn_norm": jnp.zeros((2, 8))},
+              "output": w_bad}
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    sh = param_shardings(params, mesh)
+    assert sh["layers"]["w_down"]["q5p"] is not None
+    sharded = shard_params(params, mesh)
+    assert sharded["layers"]["w_down"]["q5p"].shape == ws["q5p"].shape
+    head_spec = sharded["output"]["q5p"].sharding.spec
+    assert all(a is None for a in head_spec), head_spec

@@ -168,7 +168,10 @@ def test_shipped_kernel_defaults_are_the_measured_configuration():
 
     assert Q4K_VARIANTS[0] == "resplit"
     assert Q6K_VARIANTS[0] == "cur"
-    assert Q5K_VARIANTS[0] == "cur"
+    # q5k=pre since the 2026-08-01 q5km A/B: 63.09 vs 52.27 tok/s
+    # (bench_q5km_pre_2026-08-01.json vs bench_q5km_2026-08-01.json,
+    # kernel_microbench_q5kpre_2026-08-01.json)
+    assert Q5K_VARIANTS[0] == "pre"
 
 
 def test_resplit_variant_bit_identical(monkeypatch):

@@ -90,7 +90,8 @@ def test_stacked_under_jit_scan_layer_ids():
 _PLANE_SPEC = {
     # quantized value planes (L, N, K/x) → N on tp
     "qs": P(None, "tp", None), "q5s": P(None, "tp", None),
-    "q5h": P(None, "tp", None), "q4": P(None, "tp", None),
+    "q5h": P(None, "tp", None), "q5p": P(None, "tp", None),
+    "q4": P(None, "tp", None), "q6p": P(None, "tp", None),
     "q2": P(None, "tp", None), "q8": P(None, "tp", None),
     # scale planes (L, kt, N, 128) → N on tp
     "sm": P(None, None, "tp", None), "sm5": P(None, None, "tp", None),

@@ -66,6 +66,8 @@ def weight_bytes(fmt: str, n: int, k: int, variant: str = "") -> int:
     int8 plane (1 B/weight) instead of the 0.75 B/weight split."""
     if fmt == "q4k":                       # qs N*K/2 + sm (K/2048)*N*128*2
         return n * k // 2 + (k // 2048) * n * 128 * 2
+    if fmt == "q5k" and variant == "pre":  # combined plane + sm
+        return n * k + (k // 2048) * n * 128 * 2
     if fmt == "q5k":                       # q4 plane + hi-bit plane + sm
         return n * k // 2 + n * k // 8 + (k // 2048) * n * 128 * 2
     if fmt == "q6k" and variant == "pre":  # combined plane + bf16 scales/16
