@@ -115,6 +115,7 @@ step bench_server_fullctx env LFKT_BENCH_FULLCTX=1 python bench_server.py
 #     VERDICT r4 lists the missing Mistral number among the THREE missing
 #     items, so it outranks the tier-3 scheduler benches in a short window
 step bench_mistral env LFKT_BENCH_PRESET=mistral-7b python bench.py
+step bench_q5km env LFKT_BENCH_FMT=q5km python bench.py
 [ "$TIER" -le 2 ] && { echo "=== tier-2 done ===" >&2; exit 0; }
 
 # 6) multiturn conversation: prompt-prefix KV reuse through the stack
