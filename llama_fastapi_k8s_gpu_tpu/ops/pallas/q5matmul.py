@@ -72,9 +72,10 @@ from .qmatmul import (
 # at a Q5_K_M file's ~2/3 Q5_K weight share), and vs the f32 oracle the
 # pre plane rounds strictly fewer terms than the split path (equal or
 # better accuracy; dev vs `cur` ~3.5e-3 is two-roundings distance, inside
-# the 5e-3 parity gate).  Cost: 1 B/weight in HBM vs the split's 0.625
-# (~+1.2 GB on an 8B Q5_K_M) — flip LFKT_Q5K_KERNEL=cur to trade the
-# speed back for capacity.
+# the 5e-3 parity gate).  Cost: value planes go 0.625 → 1 B/weight (the
+# sm5 scale plane, ~0.125 B/weight, is unchanged — totals 0.75 → 1.125),
+# ≈ +2 GB on an 8B Q5_K_M's ~5.5G Q5_K weights — flip
+# LFKT_Q5K_KERNEL=cur to trade the speed back for capacity.
 Q5K_VARIANTS = ("pre", "cur", "parfloor")
 
 q5k_compatible = q4k_compatible  # same divisibility classes

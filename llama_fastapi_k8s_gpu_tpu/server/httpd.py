@@ -42,7 +42,8 @@ async def _handle_request(app, reader, writer, peer, request_line,
     except ValueError:
         return False
     headers = []
-    content_length = 0
+    content_length = None
+    chunked = False
     while True:
         line = await reader.readline()
         if line in (b"\r\n", b"\n", b""):
@@ -53,11 +54,22 @@ async def _handle_request(app, reader, writer, peer, request_line,
         headers.append((name.encode(), value.encode()))
         if name == "content-length":
             try:
-                content_length = int(value)
+                cl = int(value)
             except ValueError:
                 return False        # malformed framing: close, like a bad
-            if content_length < 0:  # request line above
+            if cl < 0:              # request line above
                 return False
+            if content_length is not None and cl != content_length:
+                return False        # conflicting lengths (RFC 9112 §6.3:
+            content_length = cl     # unrecoverable — never last-one-wins)
+        elif name == "transfer-encoding":
+            chunked = True
+    if chunked:
+        # chunked request bodies are not implemented; serving the request
+        # with an empty body would leave the chunk stream in the buffer to
+        # be misparsed as the next request line — close instead
+        return False
+    content_length = content_length or 0
     body = await reader.readexactly(content_length) if content_length else b""
 
     path, _, query = target.partition("?")
@@ -226,6 +238,11 @@ async def serve(app, host: str = "0.0.0.0", port: int = 8000,
         await stop.wait()
         state["draining"] = True
         server.close()            # stop accepting; existing tasks continue
+        # one short tick before closing "idle" connections: a request whose
+        # bytes are already buffered but whose handler is still parked in
+        # readline() would otherwise be closed unserved — the wakeup lets
+        # it claim busy status and ride the drain instead
+        await asyncio.sleep(0.05)
         _close_conns(state, only_idle=True)   # idle keep-alives: EOF now
         if state["active"]:
             logger.info("httpd draining %d in-flight request(s) (≤%.0fs)",
