@@ -138,7 +138,8 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
     ``attn_v``/``ffn_down``/``output`` (~0.88 B/w), fused Q4_K for the rest
     (~0.63 B/w) — mirroring coldstart_main's file writer (the repo's
     file-fidelity definition).  ``fmt="q5km"``: the Q5_K_M analogue —
-    the same Q6_K tensors plus fused Q5_K for the rest (~0.75 B/w).  Slightly conservative vs a genuine
+    the same Q6_K tensors plus fused Q5_K for the rest (~0.75 B/w split /
+    ~1.125 B/w under the default ``pre`` layout).  Slightly conservative vs a genuine
     llama.cpp artifact, whose ``use_more_bits`` recipe puts only about
     half the ffn_down layers on Q6_K (~5% fewer HBM bytes/token than this
     grid); a real Q4_K_M file (reference api.py:14) serves at or above
@@ -162,7 +163,8 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
             want = "q5k"
         if want == "q5k" and q4k_compatible(out_dim, in_dim, for_tpu=True):
             # fused Q5_K layout (ops/pallas/q5matmul.py): combined-nibble
-            # plane + high-bit plane + lane-tiled scales, ~0.75 B/weight.
+            # plane + high-bit plane + lane-tiled scales, ~0.75 B/w split /
+            # ~1.125 B/w under the default `pre` layout.
             # LAYOUT variants must be honored here too — the kernels
             # dispatch on plane presence, so a synthetic split grid under
             # LFKT_Q5K_KERNEL=pre would silently A/B the split path
@@ -519,10 +521,15 @@ def child_main() -> None:
         cfg, p_def, ctx_def, attn_def = mcfg, 128, MISTRAL_7B.n_ctx, "pallas"
     else:
         cfg, p_def, ctx_def, attn_def = LLAMA3_8B, 128, LLAMA3_8B.n_ctx, "pallas"
+    # kv_dtype axis (same knob as the server, utils/config.py): int8 halves
+    # the ring's HBM reads — the next BENCH round compares bf16 vs int8
+    # decode throughput and max-lane headroom on one grid
+    kv_dtype = os.environ.get("LFKT_KV_DTYPE", "bf16")
     cfg = dataclasses.replace(
         cfg,
         n_ctx=int(os.environ.get("LFKT_BENCH_NCTX", ctx_def)),
         attn_impl=os.environ.get("LFKT_BENCH_ATTN", attn_def),
+        kv_dtype=kv_dtype,
     )
     prompt_len = int(os.environ.get("LFKT_BENCH_PROMPT", p_def))
     gen_tokens = int(os.environ.get(
@@ -555,12 +562,26 @@ def child_main() -> None:
         fallbacks["fmt_fallback"] = reason
         fmt_label = "int8"
     if cfg.attn_impl == "pallas":
-        err = probe_flash_attention()
+        err = probe_flash_attention(quantized=cfg.kv_dtype == "int8")
         if err is not None:
             fallbacks["attn_fallback"] = f"flash attention: {err}"[:300]
             print(f"bench: {fallbacks['attn_fallback']}; using attn_impl=xla",
                   file=sys.stderr, flush=True)
             cfg = dataclasses.replace(cfg, attn_impl="xla")
+    if cfg.kv_dtype == "int8":
+        # mirror the engine's degrade path (engine.py): a failed quantize-
+        # kernel probe pins the identical XLA write formulation
+        from llama_fastapi_k8s_gpu_tpu.ops.pallas.kvquant import (
+            force_xla_quant,
+        )
+        from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import probe_kv_quant
+
+        err = probe_kv_quant()
+        if err is not None:
+            fallbacks["kv_quant_fallback"] = f"kv quantize: {err}"[:300]
+            print(f"bench: {fallbacks['kv_quant_fallback']}; quantizing "
+                  f"cache writes via XLA", file=sys.stderr, flush=True)
+            force_xla_quant(True)
 
     t0 = time.time()
     params = synth_params_device(cfg, fmt=wfmt)
@@ -623,8 +644,14 @@ def child_main() -> None:
     chunk = max(sweep, key=lambda c: chunk_sweep[str(c)])
     tok_s = chunk_sweep[str(chunk)]
 
+    from llama_fastapi_k8s_gpu_tpu.models.llama import cache_nbytes
+
+    # label honesty: a non-default KV dtype gets its own metric key so a
+    # BENCH round can carry bf16 and int8 rows side by side
+    kv_tag = "" if cfg.kv_dtype == "bf16" else f",kv-{cfg.kv_dtype}"
     result = {
-        "metric": f"decode_tokens_per_sec_per_chip[{preset},{fmt_label},synthetic]",
+        "metric": (f"decode_tokens_per_sec_per_chip"
+                   f"[{preset},{fmt_label}{kv_tag},synthetic]"),
         "value": round(tok_s, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tok_s / A10G_Q4KM_8B_TOK_S, 3),
@@ -632,6 +659,8 @@ def child_main() -> None:
         "prompt_tokens": prompt_len,
         "n_ctx": cfg.n_ctx,
         "attn_impl": cfg.attn_impl,
+        "kv_dtype": cfg.kv_dtype,
+        "kv_cache_bytes": cache_nbytes(cfg),
         "gen_tokens": max(1, gen_tokens // chunk) * chunk,
         "decode_chunk": chunk,
         "chunk_sweep": chunk_sweep,

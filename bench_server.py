@@ -95,6 +95,13 @@ def main() -> None:
             isinstance(v, dict) and any(fk in v for fk in fused_key)
             for v in [*params["layers"].values(), params["output"]]):
         wfmt = "int8"  # label honesty: tiny shapes fall back
+    # kv_dtype axis (docs/KV_CACHE.md): the engines read it off cfg, and a
+    # non-default dtype rides the wfmt label so every result metric keys
+    # its arm (same convention as bench.py's kv-int8 tag)
+    kv_dtype = os.environ.get("LFKT_KV_DTYPE", "bf16")
+    cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype)
+    if kv_dtype != "bf16":
+        wfmt = f"{wfmt},kv-{kv_dtype}"
     batch = int(os.environ.get("LFKT_BENCH_BATCH", "1"))
     # the app sizes its in-flight permit pool from settings.batch_size
     # (server/app.py: Semaphore(max(1, settings.batch_size))) — without

@@ -50,11 +50,11 @@ def _write_lane(state: dict, lane_st: dict, lane: jax.Array, cache1: dict,
                 pos, token, window, wpos, key, st: dict):
     """Install a freshly prefilled sequence into batch lane ``lane``.
     ``cache1`` is NOT donated — the scheduler reuses it as the next
-    admission's prefill scratch (no per-request cache allocation)."""
-    new_cache = {
-        "k": state["cache"]["k"].at[lane].set(cache1["k"]),
-        "v": state["cache"]["v"].at[lane].set(cache1["v"]),
-    }
+    admission's prefill scratch (no per-request cache allocation).  Leaf-
+    generic over the cache pytree ({k, v} bf16 or the int8 four-leaf
+    layout — models/llama.py init_cache)."""
+    new_cache = jax.tree.map(
+        lambda a, c: a.at[lane].set(c), state["cache"], cache1)
     new_state = {
         "cache": new_cache,
         "pos": state["pos"].at[lane].set(pos),
@@ -72,8 +72,9 @@ def _write_lane(state: dict, lane_st: dict, lane: jax.Array, cache1: dict,
 def _lane_cache_copy_jit(cache: dict, lane) -> dict:
     """Snapshot one lane's KV ring into a scratch-shaped cache (lane-prefix
     reuse: the copy becomes the next admission's prefill scratch, so the
-    suffix slices start from the reused history instead of position 0)."""
-    return {"k": cache["k"][lane], "v": cache["v"][lane]}
+    suffix slices start from the reused history instead of position 0).
+    Leaf-generic over the cache pytree (bf16 or int8 layout)."""
+    return jax.tree.map(lambda a: a[lane], cache)
 
 
 _STREAM_END = object()   # scheduler→stream-consumer sentinel
@@ -345,8 +346,8 @@ class ContinuousEngine(MeshEngine):
             # compile the lane→scratch snapshot gather (one program; the
             # suffix slice shapes are already in the warmed set above)
             jax.block_until_ready(_lane_cache_copy_jit(
-                self._bstate["cache"], jnp.int32(0))["k"])
-        jax.block_until_ready(cache["k"])
+                self._bstate["cache"], jnp.int32(0)))
+        jax.block_until_ready(cache)
         logger.info("continuous warmup done in %.1fs (%d lanes)",
                     time.time() - t0, self.batch_size)
 

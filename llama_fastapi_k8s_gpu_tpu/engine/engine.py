@@ -127,6 +127,8 @@ class Engine:
         max_gen_tokens: int = 512,
         seed: int = 0,
         attn_impl: str = "auto",  # auto | xla | pallas (prefill flash kernel)
+        kv_dtype: str | None = None,  # bf16 | int8 KV cache; None keeps the
+        #                               cfg's value (docs/KV_CACHE.md)
         spec_decode: str = "off",  # off | lookup (prompt-lookup speculation)
         spec_draft: int = 8,
         prefix_cache: bool = True,  # reuse the previous request's KV prefix
@@ -140,6 +142,10 @@ class Engine:
         if spec_decode not in ("off", "lookup", "auto"):
             raise ValueError(
                 f"spec_decode must be off|lookup|auto, got {spec_decode!r}")
+        # validated BEFORE the weight load: a typo'd LFKT_KV_DTYPE must
+        # fail in milliseconds, not after a multi-GB load per crash loop
+        if kv_dtype is not None and kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype must be bf16|int8, got {kv_dtype!r}")
         if spec_decode != "off" and not 1 <= spec_draft < n_ctx - 1:
             raise ValueError(
                 f"spec_draft must be in [1, n_ctx-2], got {spec_draft}")
@@ -227,6 +233,20 @@ class Engine:
                 model_path, gf.architecture, self.cfg.n_layers, weight_format,
                 time.time() - t0,
             )
+        if kv_dtype is not None and kv_dtype != self.cfg.kv_dtype:
+            self.cfg = dataclasses.replace(self.cfg, kv_dtype=kv_dtype)
+        if self.cfg.kv_dtype == "int8":
+            # compile-probe the KV write-quantize kernel NOW: a Mosaic
+            # failure degrades writes to the identical XLA formulation
+            # instead of crash-looping the pod at its first prefill
+            from ..ops.pallas.kvquant import force_xla_quant
+            from ..ops.pallas.probe import probe_kv_quant
+
+            err = probe_kv_quant()
+            if err is not None:
+                force_xla_quant(True)
+                logger.error("pallas kv-quantize kernel failed its compile "
+                             "probe; cache writes quantize via XLA: %s", err)
         if attn_impl == "auto":
             # the flash kernel wants lane-aligned heads; anything else (tiny
             # test models, CPU runs) stays on the XLA score-matrix path
@@ -240,10 +260,13 @@ class Engine:
         if attn_impl == "pallas":
             # compile-probe the flash kernel NOW (ops/pallas/probe.py): a
             # Mosaic lowering failure degrades to the XLA path with correct
-            # attribution instead of crash-looping the pod at warmup
+            # attribution instead of crash-looping the pod at warmup.  An
+            # int8 cache serves prefill through the fused-dequant variant,
+            # a different Mosaic program — probe the one we'll run.
             from ..ops.pallas.probe import probe_flash_attention
 
-            err = probe_flash_attention()
+            err = probe_flash_attention(
+                quantized=self.cfg.kv_dtype == "int8")
             if err is not None:
                 logger.error("pallas flash attention failed its compile "
                              "probe; serving with attn_impl=xla: %s", err)
@@ -288,6 +311,23 @@ class Engine:
         #: token ids whose KV occupy ring slots [0, len) — only ever read
         #: and written under self._lock (the single-generator invariant)
         self._prefix_ids: list[int] = []
+
+    # ------------------------------------------------------------------
+    @property
+    def kv_cache_bytes(self) -> int:
+        """Logical HBM bytes of EVERY resident KV ring this engine holds:
+        the serial ring, the batched lane state (mesh/continuous), and the
+        continuous scheduler's persistent prefill scratch — summed from the
+        live pytrees so the /health and /metrics figure matches what
+        actually sits in HBM (docs/KV_CACHE.md lane-headroom math).
+        ``.nbytes`` is shape metadata, safe even on donated buffers."""
+        total = 0
+        for cache in (getattr(self, "_cache", None),
+                      getattr(self, "_scratch_cache", None),
+                      (getattr(self, "_bstate", None) or {}).get("cache")):
+            if cache is not None:
+                total += sum(leaf.nbytes for leaf in jax.tree.leaves(cache))
+        return total
 
     # ------------------------------------------------------------------
     @classmethod

@@ -319,9 +319,14 @@ def quant_iq4_xs(x: np.ndarray) -> np.ndarray:
     x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1, QK_K)
     nb = x.shape[0]
     sub = x.reshape(nb, 8, 32)
-    dl_sub = np.abs(sub).max(axis=2) / 113.0                    # ≥ 0
-    mx = dl_sub.max(axis=1)
-    d = np.where(mx > 0, mx / 31.0, 0.0).astype(np.float16)     # ls−32 ≤ 31
+    # signed fit against the max-magnitude element (as quant_q3_k does):
+    # map it onto the kvalue table's wider −127 end, so sub-block scales
+    # carry its sign and use the full −32..31 range instead of only 32..63
+    idx = np.abs(sub).argmax(axis=2)
+    maxv = np.take_along_axis(sub, idx[:, :, None], axis=2)[:, :, 0]
+    dl_sub = maxv / -127.0                                      # signed
+    amax = np.abs(dl_sub).max(axis=1)
+    d = np.where(amax > 0, amax / 31.0, 0.0).astype(np.float16)  # |ls−32| ≤ 31
     invd = np.where(d > 0, 1.0 / d.astype(np.float32), 0.0)
     ls = np.clip(np.round(dl_sub * invd[:, None]) + 32, 0, 63).astype(np.uint8)
     dl_q = d.astype(np.float32)[:, None] * (ls.astype(np.float32) - 32.0)

@@ -34,6 +34,12 @@ class ModelConfig:
     # the parallel/ring.py entry points (sp_prefill / sp_decode_step), which
     # establish the mesh context the ring ops need.
     attn_impl: str = "xla"
+    # KV-cache storage dtype: "bf16" (the default two-leaf {k, v} ring) or
+    # "int8" (four-leaf {k_q, v_q, k_s, v_s}: int8 values + per-head
+    # per-token symmetric f32 scales — ops/pallas/kvquant.py writes them,
+    # the attention consumers dequantize in-register).  Static so the cache
+    # pytree STRUCTURE is fixed at trace time (docs/KV_CACHE.md).
+    kv_dtype: str = "bf16"
 
     @property
     def head_dim(self) -> int:

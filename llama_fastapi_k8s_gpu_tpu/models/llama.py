@@ -58,16 +58,43 @@ def init_cache(cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
     decode step and per flash prefill call (VERDICT r3 #9, ≤ ~1 ms/token
     at 8k).  Writers pay instead: the S NEW tokens' (S, n_kv, hd) slab is
     transposed before its dynamic_update_slice — S ≤ bucket-size, not
-    n_ctx."""
+    n_ctx.
+
+    ``cfg.kv_dtype == "int8"`` swaps the two bf16 leaves for the quantized
+    layout (docs/KV_CACHE.md): int8 value rings ``k_q``/``v_q`` of the same
+    shape plus per-head, per-token symmetric f32 scales ``k_s``/``v_s``
+    (L, n_kv, n_ctx) — HBM per token-head drops 2·hd → hd + 4 bytes, and
+    attention reads stream int8."""
     shape = (cfg.n_layers, cfg.n_kv_heads, cfg.n_ctx, cfg.head_dim)
+    if cfg.kv_dtype == "int8":
+        sshape = shape[:-1]
+        return {
+            "k_q": jnp.zeros(shape, jnp.int8),
+            "v_q": jnp.zeros(shape, jnp.int8),
+            "k_s": jnp.zeros(sshape, jnp.float32),
+            "v_s": jnp.zeros(sshape, jnp.float32),
+        }
+    if cfg.kv_dtype not in ("bf16", "bfloat16"):
+        raise ValueError(f"kv_dtype must be bf16|int8, got {cfg.kv_dtype!r}")
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _layer(h, layers, i, ck_all, cv_all, positions, pos_offset,
+def cache_nbytes(cfg: ModelConfig) -> int:
+    """HBM bytes of ONE cache ring under ``cfg`` (batched engines hold one
+    per lane) — the /health ``kv_cache_bytes`` figure and the lane-headroom
+    math in docs/KV_CACHE.md, computed from shapes so callers never need a
+    live cache."""
+    per_tok_head = cfg.head_dim * (1 if cfg.kv_dtype == "int8" else 2) \
+        + (4 if cfg.kv_dtype == "int8" else 0)
+    return 2 * cfg.n_layers * cfg.n_kv_heads * cfg.n_ctx * per_tok_head
+
+
+def _layer(h, layers, i, cache, positions, pos_offset,
            cfg: ModelConfig):
     """One transformer block over S tokens against layer ``i`` of the
-    stacked weights. ck_all/cv_all: the FULL stacked cache, head-major
-    (L, n_kv, n_ctx, hd).
+    stacked weights. ``cache``: the FULL stacked cache pytree, head-major
+    (L, n_kv, n_ctx, hd) value leaves (+ (L, n_kv, n_ctx) scale leaves
+    under ``kv_dtype=int8``).
 
     The weights stay STACKED (L, ...) and are addressed per layer with
     :func:`ops.linear.linear_at` — scanning them as xs would materialize a
@@ -79,9 +106,13 @@ def _layer(h, layers, i, ck_all, cv_all, positions, pos_offset,
     ring every step — ~256 MB/token at n_ctx 1024, ~2 GB at 8192."""
     S = h.shape[0]
     n_kv, group, hd = cfg.n_kv_heads, cfg.n_heads // cfg.n_kv_heads, cfg.head_dim
+    quant = cfg.kv_dtype == "int8"
 
     def lin(x, name):
         return linear_at(x, layers[name], i)
+
+    def at_layer(leaf):
+        return jax.lax.dynamic_index_in_dim(leaf, i, axis=0, keepdims=False)
 
     hn = rms_norm(h, layers["attn_norm"][i], cfg.rms_eps)
     q = lin(hn, "wq").reshape(S, cfg.n_heads, hd)
@@ -90,20 +121,50 @@ def _layer(h, layers, i, ck_all, cv_all, positions, pos_offset,
     q = rope_interleaved(q, positions, cfg.rope_theta)
     k = rope_interleaved(k, positions, cfg.rope_theta)
 
-    # head-major write: transpose only the S new tokens, not the ring
-    kh = k.astype(ck_all.dtype).transpose(1, 0, 2)     # (n_kv, S, hd)
-    vh = v.astype(cv_all.dtype).transpose(1, 0, 2)
-    ck_all = jax.lax.dynamic_update_slice(
-        ck_all, kh[None], (i, 0, pos_offset, 0))
-    cv_all = jax.lax.dynamic_update_slice(
-        cv_all, vh[None], (i, 0, pos_offset, 0))
-    ck = jax.lax.dynamic_index_in_dim(ck_all, i, axis=0, keepdims=False)
-    cv = jax.lax.dynamic_index_in_dim(cv_all, i, axis=0, keepdims=False)
+    if quant:
+        # quantize ONLY the S new tokens' head-major slab (kvquant.py: int8
+        # values + per-head per-token f32 scales), then write both planes
+        from ..ops.pallas.kvquant import quantize_kv
+
+        kq, ks = quantize_kv(k.transpose(1, 0, 2))     # (n_kv, S, hd)
+        vq, vs = quantize_kv(v.transpose(1, 0, 2))
+        cache = {
+            "k_q": jax.lax.dynamic_update_slice(
+                cache["k_q"], kq[None], (i, 0, pos_offset, 0)),
+            "v_q": jax.lax.dynamic_update_slice(
+                cache["v_q"], vq[None], (i, 0, pos_offset, 0)),
+            "k_s": jax.lax.dynamic_update_slice(
+                cache["k_s"], ks[None], (i, 0, pos_offset)),
+            "v_s": jax.lax.dynamic_update_slice(
+                cache["v_s"], vs[None], (i, 0, pos_offset)),
+        }
+        ck, cv = at_layer(cache["k_q"]), at_layer(cache["v_q"])
+        cks, cvs = at_layer(cache["k_s"]), at_layer(cache["v_s"])
+    else:
+        # head-major write: transpose only the S new tokens, not the ring
+        kh = k.astype(cache["k"].dtype).transpose(1, 0, 2)   # (n_kv, S, hd)
+        vh = v.astype(cache["v"].dtype).transpose(1, 0, 2)
+        cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], kh[None], (i, 0, pos_offset, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], vh[None], (i, 0, pos_offset, 0)),
+        }
+        ck, cv = at_layer(cache["k"]), at_layer(cache["v"])
+        cks = cvs = None
 
     if cfg.attn_impl == "ring":
         # sequence-parallel: KV sharded over the sp mesh axis (parallel/ring.py)
         from ..parallel.ring import ring_attention, sharded_decode_attention
 
+        if quant:
+            # the ring collectives pass K/V chunks chip-to-chip, so this
+            # path materializes the layer's ring in bf16 (elementwise →
+            # stays sp-sharded); only XLA/flash get the fused-scale reads
+            from ..ops.pallas.kvquant import dequantize_kv
+
+            ck = dequantize_kv(ck, cks, h.dtype)
+            cv = dequantize_kv(cv, cvs, h.dtype)
         attn = ring_attention if S > 1 else sharded_decode_attention
         ctx = attn(
             q, ck, cv, pos_offset,
@@ -111,13 +172,16 @@ def _layer(h, layers, i, ck_all, cv_all, positions, pos_offset,
             sliding_window=cfg.sliding_window,
         ).reshape(S, cfg.n_heads * hd).astype(h.dtype)
     elif cfg.attn_impl == "pallas" and S > 1:
-        # blockwise flash kernel: streams K/V, never materializes scores
+        # blockwise flash kernel: streams K/V, never materializes scores;
+        # int8 caches ride the fused-dequant path (scales folded in-kernel)
         from ..ops.pallas import flash_attention, use_interpret
 
         ctx = flash_attention(
             q, ck, cv, pos_offset,
             sm_scale=hd ** -0.5,
             sliding_window=cfg.sliding_window,
+            k_scale=cks,
+            v_scale=cvs,
             interpret=use_interpret(),
         ).reshape(S, cfg.n_heads * hd).astype(h.dtype)
     else:
@@ -125,9 +189,20 @@ def _layer(h, layers, i, ck_all, cv_all, positions, pos_offset,
         qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
         kk = ck                     # (n_kv, n_ctx, hd) — head-major already
         vv = cv
-        scores = jnp.einsum(
-            "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
-        ) * (hd ** -0.5)  # (n_kv, group, S, n_ctx)
+        if quant:
+            # scores are linear in K, so the per-token scale factors out of
+            # the contraction: einsum over the RAW int8 ring (the int8→bf16
+            # convert fuses into the dot's operand read — HBM moves int8),
+            # then scale each key column once.  No dequantized ring is ever
+            # materialized.
+            scores = jnp.einsum(
+                "ngsh,nch->ngsc", qg, kk.astype(qg.dtype),
+                preferred_element_type=jnp.float32,
+            ) * (hd ** -0.5) * cks[:, None, None, :]
+        else:
+            scores = jnp.einsum(
+                "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
+            ) * (hd ** -0.5)  # (n_kv, group, S, n_ctx)
 
         key_pos = jnp.arange(cfg.n_ctx)
         q_pos = positions  # (S,)
@@ -135,15 +210,22 @@ def _layer(h, layers, i, ck_all, cv_all, positions, pos_offset,
         if cfg.sliding_window:
             mask &= key_pos[None, :] > q_pos[:, None] - cfg.sliding_window
         scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
-        ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv)  # (n_kv, group, S, hd)
+        if quant:
+            # same trick on V: probs·(q·s) == (probs·s)·q — fold the value
+            # scales into the (tiny) probability matrix, contract int8
+            probs = (jax.nn.softmax(scores, axis=-1)
+                     * cvs[:, None, None, :]).astype(qg.dtype)
+            ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv.astype(qg.dtype))
+        else:
+            probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
+            ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv)  # (n_kv, group, S, hd)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(S, cfg.n_heads * hd).astype(h.dtype)
     h = h + lin(ctx, "wo")
 
     hn = rms_norm(h, layers["ffn_norm"][i], cfg.rms_eps)
     gated = jax.nn.silu(lin(hn, "w_gate").astype(jnp.float32)).astype(h.dtype)
     h = h + lin(gated * lin(hn, "w_up"), "w_down")
-    return h, ck_all, cv_all
+    return h, cache
 
 
 def forward(
@@ -180,11 +262,9 @@ def forward(
     # at n_ctx 1024, ~2 GB at 8192 — measured as most of the 8k decode gap)
     def body(i, carry):
         return _layer(carry[0], params["layers"], jnp.int32(i), carry[1],
-                      carry[2], positions, pos_offset, cfg)
+                      positions, pos_offset, cfg)
 
-    h, new_k, new_v = jax.lax.fori_loop(
-        0, cfg.n_layers, body, (h, cache["k"], cache["v"]))
-    new_cache = {"k": new_k, "v": new_v}
+    h, new_cache = jax.lax.fori_loop(0, cfg.n_layers, body, (h, cache))
 
     out_w = params["output"]
     if return_all:

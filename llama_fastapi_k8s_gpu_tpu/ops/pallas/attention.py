@@ -34,21 +34,28 @@ def _attn_kernel(
     pos_ref,            # (1,) int32 — cache position of query token 0
     # inputs
     q_ref,              # (1, BQ, hd)
-    k_ref,              # (1, BK, hd)
+    k_ref,              # (1, BK, hd) — bf16, or int8 when quantized
     v_ref,              # (1, BK, hd)
+    # quantized only (absent otherwise): per-token f32 scale blocks
+    #   ks_ref          # (1, BK)
+    #   vs_ref          # (1, BK)
     # outputs
-    o_ref,              # (1, BQ, hd)
-    # scratch
-    m_ref,              # (BQ, 128) f32  running max (lane-replicated)
-    l_ref,              # (BQ, 128) f32  running sum (lane-replicated)
-    acc_ref,            # (BQ, hd)  f32  running weighted sum
-    *,
+    *rest,              # o_ref (1, BQ, hd), then scratch:
+    # m_ref,            # (BQ, 128) f32  running max (lane-replicated)
+    # l_ref,            # (BQ, 128) f32  running sum (lane-replicated)
+    # acc_ref,          # (BQ, hd)  f32  running weighted sum
     seq_len: int,       # S — real (bucketed) query length
     block_q: int,
     block_k: int,
     sm_scale: float,
     sliding_window: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     qb = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -88,10 +95,18 @@ def _attn_kernel(
     def _body(masked: bool):
         q = q_ref[0]                               # (BQ, hd)
         k = k_ref[0]                               # (BK, hd)
+        if quantized:
+            # fused dequant, scale-last: scores are linear in K, so the
+            # per-token scale factors out of the contraction — dot the RAW
+            # int8 block (cast in-register; [-127,127] is exact in any
+            # float), then scale each key column once.  HBM moved int8.
+            k = k.astype(q.dtype)
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale                               # (BQ, BK)
+        if quantized:
+            scores = scores * ks_ref[...]          # (1, BK) bcast over rows
 
         if masked:
             row = qb * block_q + jax.lax.broadcasted_iota(
@@ -113,6 +128,11 @@ def _attn_kernel(
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
         v = v_ref[0]                               # (BK, hd)
+        if quantized:
+            # same trick on V: p·(q·s) == (p·s)·q — fold the value scales
+            # into the (BQ, BK) probability tile, contract the raw int8
+            p = p * vs_ref[...]
+            v = v.astype(q_ref.dtype)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -156,6 +176,8 @@ def flash_attention(
     sliding_window: int = 0,
     block_q: int = 512,
     block_k: int = 1024,
+    k_scale: jax.Array | None = None,  # (n_kv, n_ctx) f32 — int8 cache only
+    v_scale: jax.Array | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Causal (+ sliding-window) attention of S queries over the KV ring.
@@ -164,11 +186,17 @@ def flash_attention(
     q_pos`` makes unwritten cache slots invisible, exactly like the XLA
     path in ``models/llama.py``.  K/V arrive head-major, which is the
     kernel's own block layout — no ring-sized transpose on the way in.
+
+    With ``k_scale``/``v_scale`` (the int8 cache's per-head per-token
+    scales, docs/KV_CACHE.md), K/V are int8 and the kernel dequantizes
+    in-register — the ring's HBM traffic roughly halves, which is the
+    whole point of ``kv_dtype=int8`` on a bandwidth-bound decode chip.
     """
     S, n_heads, hd = q.shape
     n_kv, n_ctx, _ = k.shape
     group = n_heads // n_kv
     gs = group * S
+    quantized = k_scale is not None
 
     bq = _pick_block(gs, block_q)
     bk = _pick_block(n_ctx, block_k)
@@ -186,17 +214,26 @@ def flash_attention(
         block_k=bk,
         sm_scale=sm_scale,
         sliding_window=sliding_window,
+        quantized=quantized,
     )
+    in_specs = [
+        pl.BlockSpec((1, bq, hd), lambda h, qb, kb, *_: (h, qb, 0)),
+        pl.BlockSpec((1, bk, hd), lambda h, qb, kb, *_: (h, kb, 0)),
+        pl.BlockSpec((1, bk, hd), lambda h, qb, kb, *_: (h, kb, 0)),
+    ]
+    operands = [qg, kk, vv]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bk), lambda h, qb, kb, *_: (h, kb)),
+            pl.BlockSpec((1, bk), lambda h, qb, kb, *_: (h, kb)),
+        ]
+        operands += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, bq, hd), lambda h, qb, kb, *_: (h, qb, 0)),
-                pl.BlockSpec((1, bk, hd), lambda h, qb, kb, *_: (h, kb, 0)),
-                pl.BlockSpec((1, bk, hd), lambda h, qb, kb, *_: (h, kb, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, bq, hd), lambda h, qb, kb, *_: (h, qb, 0)),
             scratch_shapes=[
                 pltpu.VMEM((bq, 128), jnp.float32),
@@ -206,7 +243,7 @@ def flash_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((n_kv, gs, hd), q.dtype),
         interpret=interpret,
-    )(jnp.atleast_1d(pos_offset.astype(jnp.int32)), qg, kk, vv)
+    )(jnp.atleast_1d(pos_offset.astype(jnp.int32)), *operands)
 
     # (n_kv, group, S, hd) → (S, n_heads, hd)
     return out.reshape(n_kv, group, S, hd).transpose(2, 0, 1, 3).reshape(S, n_heads, hd)

@@ -20,7 +20,7 @@ import functools
 import numpy as np
 
 __all__ = ["probe_fused_q4k", "probe_fused_q5k", "probe_fused_q6k",
-           "probe_fused_q8", "probe_flash_attention"]
+           "probe_fused_q8", "probe_flash_attention", "probe_kv_quant"]
 
 
 def _err(e: BaseException) -> str:
@@ -132,10 +132,13 @@ def probe_fused_q8() -> str | None:
         return _err(e)
 
 
-@functools.lru_cache(maxsize=1)
-def probe_flash_attention() -> str | None:
+@functools.lru_cache(maxsize=2)
+def probe_flash_attention(quantized: bool = False) -> str | None:
     """Compile + run the flash prefill kernel at the Llama-3-8B head
-    layout (32 q heads / 8 kv heads / head_dim 128) on a short sequence."""
+    layout (32 q heads / 8 kv heads / head_dim 128) on a short sequence.
+    ``quantized=True`` probes the int8-cache fused-dequant variant
+    (kv_dtype=int8 engines call both: the two lower to different Mosaic
+    programs and must degrade independently)."""
     try:
         import jax.numpy as jnp
 
@@ -145,11 +148,38 @@ def probe_flash_attention() -> str | None:
         itp = use_interpret()
         S, H, KV, HD, CTX = (8, 2, 2, 128, 32) if itp else (128, 32, 8, 128, 256)
         q = jnp.ones((S, H, HD), jnp.bfloat16)
-        k = jnp.ones((KV, CTX, HD), jnp.bfloat16)   # head-major ring layout
-        v = jnp.ones((KV, CTX, HD), jnp.bfloat16)
-        y = flash_attention(q, k, v, jnp.int32(0), sm_scale=HD ** -0.5,
-                            interpret=itp)
+        if quantized:
+            k = jnp.ones((KV, CTX, HD), jnp.int8)
+            v = jnp.ones((KV, CTX, HD), jnp.int8)
+            ks = jnp.full((KV, CTX), 1 / 127.0, jnp.float32)
+            y = flash_attention(q, k, v, jnp.int32(0), sm_scale=HD ** -0.5,
+                                k_scale=ks, v_scale=ks, interpret=itp)
+        else:
+            k = jnp.ones((KV, CTX, HD), jnp.bfloat16)  # head-major ring layout
+            v = jnp.ones((KV, CTX, HD), jnp.bfloat16)
+            y = flash_attention(q, k, v, jnp.int32(0), sm_scale=HD ** -0.5,
+                                interpret=itp)
         float(y.astype(jnp.float32).sum())
+        return None
+    except Exception as e:  # noqa: BLE001
+        return _err(e)
+
+
+@functools.lru_cache(maxsize=1)
+def probe_kv_quant() -> str | None:
+    """Compile + run the int8 KV-cache write-quantize kernel
+    (ops/pallas/kvquant.py) at a decode-like shape.  A failure degrades
+    writes to the identical XLA formulation (force_xla_quant) instead of
+    crash-looping the pod at its first prefill."""
+    try:
+        import jax.numpy as jnp
+
+        from . import use_interpret
+        from .kvquant import quantize_kv_pallas
+
+        q, s = quantize_kv_pallas(jnp.ones((8, 8, 128), jnp.bfloat16),
+                                  interpret=use_interpret())
+        float(s.sum()) + float(q.astype(jnp.float32).sum())
         return None
     except Exception as e:  # noqa: BLE001
         return _err(e)

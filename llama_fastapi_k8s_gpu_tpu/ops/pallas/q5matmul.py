@@ -35,23 +35,24 @@ from jax.experimental import pallas as pl
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
 from ...gguf.quants import unpack_scale_min_k4
 from .qmatmul import (
-    TK,
-    TKA,
-    _SUBS,
-    _env_variant,
-    _lane_repeat,
-    _interpret,
-    _pick_tn,
-    _spec_axis,
-    _tn_prefs_for,
     augment_x,
     batched_rows,
+    def_partition_compat,
+    _env_variant,
+    _interpret,
+    _lane_repeat,
     permute_x,
-    q4k_compatible,
+    _pick_tn,
     plain_pallas_call,
+    q4k_compatible,
     rows_vmappable,
+    _spec_axis,
     stacked_pallas_call,
     stacked_partitioned,
+    _SUBS,
+    TK,
+    TKA,
+    _tn_prefs_for,
 )
 
 # `pre` is a LAYOUT variant in the Q6_K mold (q6matmul.py): prep stores one
@@ -358,7 +359,8 @@ def _q5k_pre_2d_partitioned(interpret: bool):
             mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
                     _spec_axis(arg_shapes[1].sharding, 0)))
 
-    fn.def_partition(
+    def_partition_compat(
+        fn,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule="b k, n j, t n l -> b n",
@@ -456,7 +458,8 @@ def _q5k_2d_partitioned(interpret: bool, variant: str = "cur"):
             mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
                     _spec_axis(arg_shapes[1].sharding, 0)))
 
-    fn.def_partition(
+    def_partition_compat(
+        fn,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule="b k, n j, n p, t n l -> b n",

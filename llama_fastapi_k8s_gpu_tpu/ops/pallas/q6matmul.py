@@ -49,20 +49,21 @@ import numpy as np
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
 from .qmatmul import (
-    TK,
+    batched_rows,
+    def_partition_compat,
     _env_variant,
     _interpret,
     _lane_repeat,
     _pick_tn,
-    _q4k_accum,
-    _spec_axis,
-    _tn_prefs_for,
-    batched_rows,
-    q4k_compatible,
     plain_pallas_call,
+    _q4k_accum,
+    q4k_compatible,
     rows_vmappable,
+    _spec_axis,
     stacked_pallas_call,
     stacked_partitioned,
+    TK,
+    _tn_prefs_for,
 )
 
 # first entry = the env-knob default (ops/pallas/qmatmul.py::_env_variant).
@@ -455,7 +456,8 @@ def _q6k_pre_2d_partitioned(interpret: bool):
             mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
                     _spec_axis(arg_shapes[1].sharding, 0)))
 
-    fn.def_partition(
+    def_partition_compat(
+        fn,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule="b k, n j, t n l -> b n",
@@ -520,7 +522,8 @@ def _q6k_2d_partitioned(interpret: bool, variant: str = "cur"):
             mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
                     _spec_axis(arg_shapes[1].sharding, 0)))
 
-    fn.def_partition(
+    def_partition_compat(
+        fn,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule="b k, n j, n p, t n l -> b n",

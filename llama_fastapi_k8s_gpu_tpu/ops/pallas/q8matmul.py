@@ -36,19 +36,20 @@ from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType
 from .qmatmul import (
-    _lane_repeat,
-    TK,
-    _interpret,
-    _pick_tn,
-    _spec_axis,
-    _tn_prefs_for,
     batched_rows,
+    def_partition_compat,
+    _interpret,
+    _lane_repeat,
     permute_x,
-    q4k_compatible,
+    _pick_tn,
     plain_pallas_call,
+    q4k_compatible,
     rows_vmappable,
+    _spec_axis,
     stacked_pallas_call,
     stacked_partitioned,
+    TK,
+    _tn_prefs_for,
 )
 
 q8_compatible = q4k_compatible  # same divisibility classes
@@ -170,7 +171,8 @@ def _q8_2d_partitioned(interpret: bool):
             mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
                     _spec_axis(arg_shapes[1].sharding, 0)))
 
-    fn.def_partition(
+    def_partition_compat(
+        fn,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule="b k, n j, t n l -> b n",

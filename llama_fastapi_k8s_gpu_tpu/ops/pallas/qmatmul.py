@@ -441,7 +441,8 @@ def _q4k_2d_partitioned(interpret: bool, variant: str = "cur"):
             mesh, P(_spec_axis(arg_shapes[0].sharding, 0),
                     _spec_axis(arg_shapes[1].sharding, 0)))
 
-    fn.def_partition(
+    def_partition_compat(
+        fn,
         partition=partition,
         infer_sharding_from_operands=infer,
         # shardy factor rule: rows (b) and output (n) propagate; K factors
@@ -544,6 +545,20 @@ def _q4k_2d_stacked_raw(idx: jax.Array, xpa: jax.Array, qs: jax.Array,
     return call(idx, xpa, qs, sm)
 
 
+def def_partition_compat(fn, **kwargs) -> None:
+    """``fn.def_partition`` with the newer ``sharding_rule`` (Shardy) kwarg
+    when this jax supports it, dropping it otherwise.  Every caller also
+    passes the GSPMD callbacks (``partition`` /
+    ``infer_sharding_from_operands``), so older-jax behavior is identical —
+    without this the whole fused-kernel family raises TypeError at first
+    trace on jax builds that predate the kwarg."""
+    try:
+        fn.def_partition(**kwargs)
+    except TypeError:
+        kwargs.pop("sharding_rule", None)
+        fn.def_partition(**kwargs)
+
+
 def rows_vmappable(fn, xpa_pos: int):
     """Give a fused matmul a vmap rule: batching over the activation
     operand is just more rows for the kernel (weights are shared across
@@ -615,7 +630,8 @@ def stacked_partitioned(raw_fn, sharding_rule: str, interpret: bool):
             mesh, P(_spec_axis(arg_shapes[1].sharding, 0),
                     _spec_axis(arg_shapes[2].sharding, 1)))
 
-    fn.def_partition(
+    def_partition_compat(
+        fn,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule=sharding_rule,

@@ -127,12 +127,14 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
 
 def cache_shardings(cfg: ModelConfig, mesh: Mesh, batched: bool = False):
     """Head-major KV cache (L, n_kv, ctx, hd): kv-heads over tp; batch (if
-    any) over dp."""
-    if batched:
-        s = _ns(mesh, "dp", None, "tp", None, None)
-    else:
-        s = _ns(mesh, None, "tp", None, None)
-    return {"k": s, "v": s}
+    any) over dp.  Under ``kv_dtype=int8`` the int8 value rings keep that
+    spec and the (L, n_kv, ctx) scale planes get it minus the hd axis."""
+    lead = ("dp",) if batched else ()
+    s4 = _ns(mesh, *lead, None, "tp", None, None)
+    if cfg.kv_dtype == "int8":
+        s3 = _ns(mesh, *lead, None, "tp", None)
+        return {"k_q": s4, "v_q": s4, "k_s": s3, "v_s": s3}
+    return {"k": s4, "v": s4}
 
 
 def state_shardings(cfg: ModelConfig, mesh: Mesh, batched: bool = False) -> dict:

@@ -35,7 +35,16 @@ import threading
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:                                  # newer jax: top-level export
+    from jax import shard_map
+except ImportError:                   # older jax: the experimental home, with
+    # check_vma spelled check_rep — shim the one call-site kwarg we use
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
@@ -216,9 +225,14 @@ def sharded_decode_attention(
 
 def sp_state_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
     """Head-major cache (L, n_kv, n_ctx, hd): n_ctx sharded over sp,
-    kv-heads over tp."""
-    s = NamedSharding(mesh, P(None, "tp", "sp", None))
-    return {"k": s, "v": s}
+    kv-heads over tp.  Int8 caches shard the (L, n_kv, n_ctx) scale planes
+    the same way minus the hd axis — the per-layer dequant before the ring
+    collectives (models/llama.py) is elementwise, so it stays sp-local."""
+    s4 = NamedSharding(mesh, P(None, "tp", "sp", None))
+    if cfg.kv_dtype == "int8":
+        s3 = NamedSharding(mesh, P(None, "tp", "sp"))
+        return {"k_q": s4, "v_q": s4, "k_s": s3, "v_s": s3}
+    return {"k": s4, "v": s4}
 
 
 def sp_gen_state_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:

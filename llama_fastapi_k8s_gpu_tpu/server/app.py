@@ -571,6 +571,10 @@ def create_app(engine=None, settings: Settings | None = None,
                 "n_ctx": getattr(cfg, "n_ctx", None),
                 "attn_impl": getattr(cfg, "attn_impl", None),
                 "weight_formats": fmt,
+                # KV-cache dtype + resident HBM bytes: the kv_dtype=int8
+                # capacity win, verifiable per pod (docs/KV_CACHE.md)
+                "kv_dtype": getattr(cfg, "kv_dtype", None),
+                "kv_cache_bytes": getattr(eng, "kv_cache_bytes", None),
             }
             # spec_decode="auto": the measured-RTT decision and its inputs
             # (engine/spec_auto.py) — operators verify the resolution here
@@ -589,6 +593,9 @@ def create_app(engine=None, settings: Settings | None = None,
         m = app.state.metrics
         if hasattr(app.state, "queue"):
             m.set_gauge("queue_depth", app.state.queue.qsize())
+        kv_bytes = getattr(app.state.engine, "kv_cache_bytes", None)
+        if kv_bytes is not None:
+            m.set_gauge("kv_cache_bytes", kv_bytes)
         stats = getattr(app.state.engine, "scheduler_stats", None)
         if stats is not None:
             for k, v in stats().items():
@@ -631,6 +638,7 @@ def _default_engine_factory(settings: Settings):
             prefill_buckets=settings.prefill_bucket_list,
             max_gen_tokens=settings.max_gen_tokens,
             attn_impl=settings.attn_impl,
+            kv_dtype=settings.kv_dtype,
             spec_decode=settings.spec_decode,
             spec_draft=settings.spec_draft,
             prefix_cache=settings.prefix_cache,

@@ -27,10 +27,30 @@ import signal
 logger = logging.getLogger(__name__)
 
 _REASONS = {
-    200: "OK", 404: "Not Found", 405: "Method Not Allowed", 408: "Request Timeout",
-    422: "Unprocessable Entity", 500: "Internal Server Error",
+    200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
+    408: "Request Timeout", 422: "Unprocessable Entity",
+    500: "Internal Server Error", 501: "Not Implemented",
     503: "Service Unavailable",
 }
+
+
+async def _reject(writer, status: int, detail: str) -> bool:
+    """Minimal error response for requests the server won't parse further
+    (malformed/conflicting Content-Length → 400, chunked transfer-coding →
+    501).  ``Connection: close`` is honest: the remaining request bytes are
+    unread, so the connection cannot be reused — but unlike the former
+    silent close the client gets told WHY (RFC 9112 §6.1/§6.3).  Returns
+    False so the caller drops the connection."""
+    body = (detail + "\n").encode()
+    writer.write(
+        f"HTTP/1.1 {status} {_REASONS.get(status, '')}\r\n"
+        f"content-length: {len(body)}\r\n"
+        "connection: close\r\n\r\n".encode() + body)
+    try:
+        await writer.drain()
+    except (ConnectionResetError, BrokenPipeError):
+        pass
+    return False
 
 
 async def _handle_request(app, reader, writer, peer, request_line,
@@ -55,20 +75,25 @@ async def _handle_request(app, reader, writer, peer, request_line,
         if name == "content-length":
             try:
                 cl = int(value)
-            except ValueError:
-                return False        # malformed framing: close, like a bad
-            if cl < 0:              # request line above
-                return False
+            except ValueError:      # malformed framing: say so, then close
+                return await _reject(writer, 400, "invalid Content-Length")
+            if cl < 0:
+                return await _reject(writer, 400, "invalid Content-Length")
             if content_length is not None and cl != content_length:
-                return False        # conflicting lengths (RFC 9112 §6.3:
-            content_length = cl     # unrecoverable — never last-one-wins)
+                # conflicting lengths (RFC 9112 §6.3: unrecoverable —
+                # never last-one-wins)
+                return await _reject(writer, 400,
+                                     "conflicting Content-Length")
+            content_length = cl
         elif name == "transfer-encoding":
             chunked = True
     if chunked:
         # chunked request bodies are not implemented; serving the request
         # with an empty body would leave the chunk stream in the buffer to
-        # be misparsed as the next request line — close instead
-        return False
+        # be misparsed as the next request line — close (with attribution)
+        # instead
+        return await _reject(writer, 501,
+                             "chunked transfer-coding not supported")
     content_length = content_length or 0
     body = await reader.readexactly(content_length) if content_length else b""
 

@@ -95,6 +95,10 @@ class Settings:
     prefill_buckets: str = "128,256,512,1024"  # padded prompt shapes to bound recompiles
     weight_format: str = "auto"     # auto | bf16 | int8 | q4k
     attn_impl: str = "auto"         # auto | xla | pallas (prefill flash kernel)
+    kv_dtype: str = "bf16"          # bf16 | int8 — int8 halves KV-cache HBM
+    #                                 (values int8 + per-head per-token f32
+    #                                 scales) and streams int8 through the
+    #                                 attention reads; docs/KV_CACHE.md
     spec_decode: str = "off"        # off | lookup | auto — prompt-lookup
     #                                 speculation; "auto" measures the
     #                                 deployment's dispatch RTT at startup
@@ -163,6 +167,7 @@ def get_settings() -> Settings:
         prefill_buckets=_env("LFKT_PREFILL_BUCKETS", Settings.prefill_buckets),
         weight_format=_env("LFKT_WEIGHT_FORMAT", Settings.weight_format),
         attn_impl=_env("LFKT_ATTN_IMPL", Settings.attn_impl),
+        kv_dtype=_env("LFKT_KV_DTYPE", Settings.kv_dtype),
         spec_decode=_env("LFKT_SPEC_DECODE", Settings.spec_decode),
         spec_draft=_env("LFKT_SPEC_DRAFT", Settings.spec_draft, int),
         prefix_cache=_env("LFKT_PREFIX_CACHE", Settings.prefix_cache, bool),

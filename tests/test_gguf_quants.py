@@ -347,7 +347,9 @@ def test_dequant_matches_scalar_reference(gtype):
         (GGMLType.Q5_K, 0.08),
         (GGMLType.Q6_K, 0.05),
         (GGMLType.IQ4_NL, 0.15),
-        (GGMLType.IQ4_XS, 0.15),
+        # tightened with the signed max-magnitude scale fit (the q3_k-style
+        # fit: sub-block scales use the full −32..31 range; was 0.15)
+        (GGMLType.IQ4_XS, 0.10),
     ],
 )
 def test_quant_roundtrip_error(gtype, rel_bound):
@@ -357,6 +359,34 @@ def test_quant_roundtrip_error(gtype, rel_bound):
     y = quants.dequantize(raw, gtype, x.size)
     rms = np.sqrt(np.mean((x - y) ** 2)) / np.sqrt(np.mean(x**2))
     assert rms < rel_bound, f"{gtype.name} round-trip rms {rms:.4f}"
+
+
+def test_iq4_xs_signed_scale_fit_uses_full_range():
+    """quant_iq4_xs fits d against the SIGNED max-magnitude element (as
+    quant_q3_k does), so sub-blocks whose extreme element is positive get a
+    negative scale (ls < 32) — the unsigned fit could only emit 32..63,
+    wasting half the 6-bit field.  Also pins that the max-magnitude element
+    of each sub-block survives the round trip near-exactly (it maps onto
+    the kvalue table's −127 end by construction)."""
+    x = rng.standard_normal(256 * 16).astype(np.float32)
+    raw = quants.quantize(x, GGMLType.IQ4_XS)
+    blocks = raw.reshape(-1, 136)
+    sh = blocks[:, 2:4].copy().view(np.uint16).reshape(-1)
+    sl = blocks[:, 4:8]
+    ib = np.arange(8)
+    ls = (((sl[:, ib // 2] >> (4 * (ib % 2))) & 0x0F)
+          | (((sh[:, None] >> (2 * ib)) & 3) << 4))
+    assert (ls < 32).any(), "no negative sub-block scales emitted"
+    assert (ls >= 32).any()
+    y = quants.dequantize(raw, GGMLType.IQ4_XS, x.size)
+    sub_x = x.reshape(-1, 32)
+    sub_y = y.reshape(-1, 32)
+    j = np.abs(sub_x).argmax(axis=1)
+    mx = np.take_along_axis(sub_x, j[:, None], axis=1)[:, 0]
+    my = np.take_along_axis(sub_y, j[:, None], axis=1)[:, 0]
+    # d is f16-rounded and ls integer-rounded; the −127 anchor keeps the
+    # extreme element within a few percent (sign always preserved)
+    np.testing.assert_allclose(my, mx, rtol=0.05, atol=1e-6)
 
 
 @pytest.mark.parametrize("gtype", [GGMLType.F16, GGMLType.BF16, GGMLType.F32])

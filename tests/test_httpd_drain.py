@@ -282,3 +282,84 @@ def test_stop_with_no_inflight_exits_promptly():
     th.join(10)
     assert not th.is_alive()
     assert time.time() - t0 < 5, "idle shutdown should not wait for drain"
+
+
+# ---------------------------------------------------------------------------
+# malformed framing: minimal 400/501 + Connection: close (not a silent drop)
+# ---------------------------------------------------------------------------
+
+def _reject_roundtrip(raw: bytes) -> tuple[int, bytes, bytes]:
+    """Send one raw request to a fresh server, return the rejection
+    response, and assert the server closed the connection after it."""
+    port = _free_port()
+    holder = _start_server(create_app(engine=FakeEngine(reply="x")), port)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(raw)
+        status, head, body = _read_response(s)
+        assert s.recv(1024) == b"", "connection must close after a reject"
+        return status, head, body
+    finally:
+        s.close()
+        _stop(holder)
+        holder["thread"].join(10)
+
+
+def test_malformed_content_length_gets_400():
+    status, head, body = _reject_roundtrip(
+        b"POST /response HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Length: banana\r\n\r\n")
+    assert status == 400, (status, head)
+    assert b"connection: close" in head.lower()
+    assert b"Content-Length" in body
+
+
+def test_negative_content_length_gets_400():
+    status, head, _body = _reject_roundtrip(
+        b"POST /response HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Length: -5\r\n\r\n")
+    assert status == 400, (status, head)
+    assert b"connection: close" in head.lower()
+
+
+def test_conflicting_content_lengths_get_400():
+    """RFC 9112 §6.3: two disagreeing Content-Length fields are
+    unrecoverable — never last-one-wins, and now attributed to the client."""
+    status, head, body = _reject_roundtrip(
+        b"POST /response HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Length: 5\r\nContent-Length: 6\r\n\r\nhello")
+    assert status == 400, (status, head)
+    assert b"connection: close" in head.lower()
+    assert b"conflicting" in body
+
+
+def test_chunked_body_gets_501():
+    status, head, body = _reject_roundtrip(
+        b"POST /response HTTP/1.1\r\nHost: x\r\n"
+        b"Transfer-Encoding: chunked\r\n\r\n"
+        b"5\r\nhello\r\n0\r\n\r\n")
+    assert status == 501, (status, head)
+    assert b"connection: close" in head.lower()
+    assert b"chunked" in body
+
+
+def test_duplicate_equal_content_lengths_still_served():
+    """Equal duplicate Content-Length fields are valid per RFC 9112 §6.3's
+    list rule — the reject paths must not over-trigger on them."""
+    port = _free_port()
+    holder = _start_server(create_app(engine=FakeEngine(reply="dup ok")),
+                           port)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        n = str(len(PAYLOAD)).encode()
+        s.sendall(b"POST /response HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Content-Length: " + n + b"\r\n"
+                  b"Content-Length: " + n + b"\r\n\r\n" + PAYLOAD)
+        status, _head, body = _read_response(s)
+        assert status == 200, (status, body)
+        assert json.loads(body)["response"] == "dup ok"
+    finally:
+        s.close()
+        _stop(holder)
+        holder["thread"].join(10)
