@@ -42,6 +42,7 @@ from ..sampling.sample import (
     sampling_tensors,
     seed_window,
 )
+from ..utils.faults import FAULTS
 from .engine import Engine
 
 logger = logging.getLogger(__name__)
@@ -87,6 +88,14 @@ class MeshEngine(Engine):
         self._bstate = jax.device_put(
             state, state_shardings(self.cfg, self.mesh, batched=True))
 
+    def _recover_locked(self) -> None:
+        """Watchdog recovery: a crash mid-cycle may have poisoned the donated
+        batched state, so rebuild it (sharded) along with the serial ring."""
+        super()._recover_locked()
+        state = init_batched_state(self.cfg, self.batch_size)
+        self._bstate = jax.device_put(
+            state, state_shardings(self.cfg, self.mesh, batched=True))
+
     # ------------------------------------------------------------------
     def warmup(self):
         """Compile every shape a request can hit: the batched prefill for
@@ -123,9 +132,18 @@ class MeshEngine(Engine):
         max_tokens: int | None = None,
         stop: Sequence[str] | str | None = None,
         seed: int | None = None,
+        deadlines: Sequence[float | None] | None = None,
+        aborts: Sequence | None = None,
     ) -> list[dict]:
         """Generate up to ``batch_size`` completions in one batched program.
-        Returns one OpenAI-shaped dict per input, in order."""
+        Returns one OpenAI-shaped dict per input, in order.
+
+        ``deadlines``/``aborts`` are per-entry: entry ``b`` stops
+        accumulating tokens (``finish_reason="deadline"``) within one
+        decode chunk of its deadline passing or its abort callback firing
+        — its lane keeps stepping on-device (vmap advances every lane) but
+        the cycle ends as soon as every live entry is done, so one
+        timed-out request no longer pins the whole batch to its budget."""
         if not batch_messages:
             return []
         if len(batch_messages) > self.batch_size:
@@ -141,11 +159,28 @@ class MeshEngine(Engine):
             repeat_penalty=repeat_penalty,
         )
         with self._lock:
-            return self._generate_batch(list(batch_messages), sp, max_tokens,
-                                        stop, seed)
+            self.heartbeat.enter()
+            try:
+                return self._generate_batch(list(batch_messages), sp,
+                                            max_tokens, stop, seed,
+                                            deadlines=deadlines, aborts=aborts)
+            except Exception as e:  # noqa: BLE001 — burst detection, re-raised
+                self._note_error(e)
+                raise
+            finally:
+                self.heartbeat.leave()
 
     # ------------------------------------------------------------------
-    def _generate_batch(self, batch_messages, sp, max_tokens, stops, seed):
+    @staticmethod
+    def _lane_expired(b: int, deadlines, aborts, now: float) -> bool:
+        if aborts is not None and b < len(aborts) and aborts[b] is not None \
+                and aborts[b]():
+            return True
+        return (deadlines is not None and b < len(deadlines)
+                and deadlines[b] is not None and now > deadlines[b])
+
+    def _generate_batch(self, batch_messages, sp, max_tokens, stops, seed,
+                        deadlines=None, aborts=None):
         B = self.batch_size
         n_real = len(batch_messages)
         dummy = [self.tokenizer.bos_id or 0]
@@ -219,6 +254,17 @@ class MeshEngine(Engine):
                 gens.append([tok])
 
         while not all(done):
+            # deadline/abort propagation: expired entries stop accumulating
+            # (and can end the cycle) within one decode chunk
+            now = time.time()
+            for b in range(B):
+                if not done[b] and self._lane_expired(b, deadlines, aborts, now):
+                    done[b] = True
+                    finishes[b] = "deadline"
+            if all(done):
+                break
+            self.heartbeat.beat()
+            FAULTS.fire("decode_step")
             remaining = max(budgets[b] - len(gens[b]) for b in range(B) if not done[b])
             n_steps = min(self.decode_chunk, remaining)
             if n_steps <= 0:

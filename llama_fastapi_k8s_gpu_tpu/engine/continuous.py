@@ -39,6 +39,8 @@ from ..parallel.batched import (
     batched_spec_verify_perlane_jit,
 )
 from ..sampling.sample import SamplingParams, sampling_tensors, seed_window
+from ..utils.faults import FAULTS
+from ..utils.health import DeadlineExceeded, EngineUnavailable
 from .batched import MeshEngine
 from .engine import Engine
 
@@ -83,10 +85,10 @@ _STREAM_END = object()   # scheduler→stream-consumer sentinel
 class _Item:
     """One queued request: a future (non-stream) OR a chunk sink (stream)."""
     __slots__ = ("future", "messages", "sp", "max_tokens", "stops", "seed",
-                 "sink", "abandoned")
+                 "sink", "abandoned", "deadline", "abort", "rid")
 
     def __init__(self, future, messages, sp, max_tokens, stops, seed,
-                 sink=None):
+                 sink=None, deadline=None, abort=None):
         self.future = future
         self.messages = messages
         self.sp = sp
@@ -95,6 +97,9 @@ class _Item:
         self.seed = seed
         self.sink = sink                    # queue.Queue for stream chunks
         self.abandoned = threading.Event()  # caller gave up: free the lane
+        self.deadline = deadline            # absolute time.time() budget
+        self.abort = abort                  # callable: caller gave up?
+        self.rid = 0                        # registry key (abandon/fail_inflight)
 
 
 class _Slot:
@@ -102,12 +107,14 @@ class _Slot:
                  "first_token", "stops", "st", "sp", "t_admit", "ttft_s",
                  "sink", "abandoned", "dec", "n_emitted", "sent_bytes",
                  "held", "cid", "created", "finished", "pending_first",
-                 "reused")
+                 "reused", "deadline", "abort")
 
     def __init__(self, item: _Item, budget, n_prompt, ids):
         self.future = item.future
         self.sink = item.sink
         self.abandoned = item.abandoned
+        self.deadline = item.deadline
+        self.abort = item.abort
         self.finished = False   # set when resolved; the pipelined loop may
         #                         still hold this slot in an in-flight
         #                         chunk's lane snapshot — harvest skips it
@@ -190,6 +197,7 @@ class ContinuousEngine(MeshEngine):
         self._pending: queue_mod.Queue = queue_mod.Queue()
         self._wake = threading.Event()
         self._stop = False
+        self._shutdown = False   # deliberate stop: recovery must refuse
         self._loop_error: BaseException | None = None
         self._thread = threading.Thread(
             target=self._loop, name="lfkt-scheduler", daemon=True)
@@ -201,35 +209,37 @@ class ContinuousEngine(MeshEngine):
                frequency_penalty: float = 0.0, presence_penalty: float = 0.0,
                repeat_penalty: float = 1.1, max_tokens: int | None = None,
                stop: Sequence[str] | str | None = None,
-               seed: int | None = None) -> Future:
+               seed: int | None = None,
+               deadline: float | None = None, abort=None) -> Future:
         """Queue one request; the scheduler admits it to a free lane.
 
         ``top_k`` is served per-request up to the engine's ``max_top_k``
         ceiling (the static k of the shared compiled program); larger values
-        are effectively clamped to the ceiling."""
+        are effectively clamped to the ceiling.  ``deadline`` (absolute
+        ``time.time()``) frees the request's lane within one decode chunk
+        of expiry, resolving the future with :class:`DeadlineExceeded`."""
         item = self._enqueue(
             messages, temperature=temperature, top_p=top_p, top_k=top_k,
             min_p=min_p, frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty, repeat_penalty=repeat_penalty,
-            max_tokens=max_tokens, stop=stop, seed=seed)
+            max_tokens=max_tokens, stop=stop, seed=seed, deadline=deadline,
+            abort=abort)
         fut = item.future
-        with self._id_lock:
-            self._req_counter += 1
-            rid = self._req_counter
-        fut._lfkt_req_id = rid
-        self._items[rid] = item
-        fut.add_done_callback(lambda f: self._items.pop(rid, None))
+        fut._lfkt_req_id = item.rid
+        fut.add_done_callback(
+            lambda f, rid=item.rid: self._items.pop(rid, None))
         return fut
 
     def _enqueue(self, messages, *, temperature, top_p, top_k, min_p,
                  frequency_penalty, presence_penalty, repeat_penalty,
-                 max_tokens, stop, seed, sink=None) -> _Item:
+                 max_tokens, stop, seed, sink=None, deadline=None,
+                 abort=None) -> _Item:
         """Shared submit/submit_stream path: guards, param normalization,
-        item construction, enqueue + scheduler wake."""
+        item construction, registry entry, enqueue + scheduler wake."""
         if self._loop_error is not None:
-            raise RuntimeError("scheduler died") from self._loop_error
+            raise EngineUnavailable("scheduler died") from self._loop_error
         if self._stop:
-            raise RuntimeError("engine has been shut down")
+            raise EngineUnavailable("engine has been shut down")
         sp = SamplingParams(
             temperature=temperature, top_p=top_p, top_k=top_k, min_p=min_p,
             frequency_penalty=frequency_penalty,
@@ -238,7 +248,17 @@ class ContinuousEngine(MeshEngine):
         if isinstance(stop, str):
             stop = [stop]
         item = _Item(None if sink is not None else Future(), list(messages),
-                     sp, max_tokens, list(stop or []), seed, sink=sink)
+                     sp, max_tokens, list(stop or []), seed, sink=sink,
+                     deadline=deadline, abort=abort)
+        with self._id_lock:
+            self._req_counter += 1
+            item.rid = self._req_counter
+        # live-request registry: abandon() routes through it, and a watchdog
+        # trip fails everything in it (fail_inflight) so no caller hangs on
+        # a wedged scheduler.  Futures deregister via their done callback
+        # (submit); streams deregister in the consumer generator's finally
+        # (submit_stream).
+        self._items[item.rid] = item
         self._pending.put(item)
         self._wake.set()
         return item
@@ -262,7 +282,8 @@ class ContinuousEngine(MeshEngine):
                       repeat_penalty: float = 1.1,
                       max_tokens: int | None = None,
                       stop: Sequence[str] | str | None = None,
-                      seed: int | None = None):
+                      seed: int | None = None,
+                      deadline: float | None = None, abort=None):
         """Queue one streaming request; returns an iterator of OpenAI chunk
         dicts produced as the request's lane decodes.  Closing the iterator
         abandons the request (its lane frees at the next chunk boundary).
@@ -272,7 +293,8 @@ class ContinuousEngine(MeshEngine):
             messages, temperature=temperature, top_p=top_p, top_k=top_k,
             min_p=min_p, frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty, repeat_penalty=repeat_penalty,
-            max_tokens=max_tokens, stop=stop, seed=seed, sink=sink)
+            max_tokens=max_tokens, stop=stop, seed=seed, sink=sink,
+            deadline=deadline, abort=abort)
 
         def gen():
             try:
@@ -285,12 +307,75 @@ class ContinuousEngine(MeshEngine):
                     yield chunk
             finally:
                 item.abandoned.set()   # no-op if the stream finished cleanly
+                self._items.pop(item.rid, None)
         return gen()
 
     def create_chat_completion(self, messages, stream: bool = False, **kw):
         if stream:  # streams ride scheduler lanes too (concurrent with
             return self.submit_stream(messages, **kw)  # batched requests)
         return self.submit(messages, **kw).result()
+
+    def failure(self) -> BaseException | None:
+        """Watchdog hook: the exception that killed the scheduler loop, or
+        None while it is (believed) healthy."""
+        return self._loop_error
+
+    def fail_inflight(self, exc: BaseException) -> None:
+        """Resolve every registered live request with ``exc`` (watchdog
+        trip): callers get their 503 NOW instead of hanging on a wedged or
+        dead scheduler until their own timeouts fire.  Items are marked
+        abandoned so a still-running loop discards their lanes at the next
+        harvest instead of double-resolving."""
+        for item in list(self._items.values()):
+            item.abandoned.set()
+            if item.future is not None:
+                if not item.future.done():
+                    try:
+                        item.future.set_exception(exc)
+                    except Exception:  # noqa: BLE001 — lost race with the loop
+                        pass
+            elif item.sink is not None:
+                item.sink.put(exc)
+
+    def recover(self) -> bool:
+        """Bounded recovery (engine/watchdog.py): restart a *dead* scheduler
+        on rebuilt device state.  Refuses while the loop thread is alive and
+        unfailed — a wedged thread may still own the donated buffers, and
+        restarting state under it would race; the watchdog then escalates
+        to DEAD and the pod restart frees the device.  Also refuses after a
+        deliberate :meth:`shutdown` (that is not a fault)."""
+        FAULTS.fire("recover")   # injection point: recovery that fails
+        if self._shutdown:
+            return False
+        if self._thread.is_alive() and self._loop_error is None:
+            return False
+        self._thread.join(timeout=2)
+        if self._thread.is_alive():
+            return False
+        # fallible device re-init FIRST: if it raises (e.g. OOM — a likely
+        # condition for recovery to run under), _loop_error must remain set
+        # so the watchdog keeps seeing a dead engine and _enqueue keeps
+        # refusing — clearing it early would leave a zombie with READY
+        # probes and no scheduler thread, queueing every request into a
+        # 408 (code-review r2 finding)
+        with self._lock:
+            self._recover_locked()          # fresh serial ring + batched state
+        self._scratch_cache = init_cache(self.cfg)
+        base_st = sampling_tensors(SamplingParams())
+        self._lane_st = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (self.batch_size,)), base_st)
+        # re-init succeeded: clear the fault signature and restart
+        self._loop_error = None
+        self._stop = False
+        self._adm = None
+        self._items.clear()
+        self._lane_claims = [None] * self.batch_size
+        self._stats = {"lanes_live": 0, "pending": 0, "admission_inflight": 0}
+        self.heartbeat.reset()
+        self._thread = threading.Thread(
+            target=self._loop, name="lfkt-scheduler", daemon=True)
+        self._thread.start()
+        return True
 
     def create_chat_completions(self, batch_messages, **kw) -> list[dict]:
         futs = [self.submit(m, **kw) for m in batch_messages]
@@ -304,6 +389,7 @@ class ContinuousEngine(MeshEngine):
         return out
 
     def shutdown(self):
+        self._shutdown = True
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
@@ -409,19 +495,34 @@ class ContinuousEngine(MeshEngine):
             return 0, None
         return best, src
 
-    def _resolve_skipped(self, item: _Item) -> None:
-        """Resolve an item the scheduler will never serve (abandoned or
-        cancelled while queued) so no awaiter hangs."""
+    def _resolve_skipped(self, item: _Item, exc: BaseException | None = None
+                         ) -> None:
+        """Resolve an item the scheduler will never serve (abandoned,
+        cancelled, or deadline-expired while queued) so no awaiter hangs."""
         if item.future is not None and not item.future.done():
-            if not item.future.cancel():
+            if exc is not None:
+                try:
+                    item.future.set_exception(exc)
+                except Exception:  # noqa: BLE001 — lost race: already resolved
+                    pass
+            elif not item.future.cancel():
                 item.future.set_exception(CancelledError())
         elif item.sink is not None:
-            item.sink.put(_STREAM_END)
+            item.sink.put(exc if exc is not None else _STREAM_END)
 
     def _begin_admission(self, item: _Item) -> dict | None:
         """Guards + tokenize + machine setup (no device work yet)."""
         if item.abandoned.is_set():
             self._resolve_skipped(item)
+            return None
+        if item.abort is not None and item.abort():
+            self._resolve_skipped(item)
+            return None
+        if item.deadline is not None and time.time() > item.deadline:
+            # expired while queued: never occupy a lane for a caller that
+            # already gave up (deadline propagation, reference-parity 408)
+            self._resolve_skipped(item, DeadlineExceeded(
+                "request deadline expired before admission"))
             return None
         if item.future is not None and not item.future.set_running_or_notify_cancel():
             return None                                # cancelled while queued
@@ -464,6 +565,7 @@ class ContinuousEngine(MeshEngine):
                 "t0": t0, "offset": reuse, "reused": reuse, "logits": None,
             }
         except Exception as e:  # noqa: BLE001 — per-request isolation
+            self._note_error(e)
             if item.future is not None:
                 item.future.set_exception(e)
             elif item.sink is not None:
@@ -473,6 +575,8 @@ class ContinuousEngine(MeshEngine):
     def _dispatch_prefill_chunk(self, adm: dict) -> None:
         """Run ONE prompt slice through the model into the scratch cache.
         Keeps the logits of the slice containing the last real token."""
+        self.heartbeat.beat()
+        FAULTS.fire("prefill")
         if self._scratch_cache is None:
             # a failed lane snapshot (_begin_admission reuse path) dropped
             # the scratch; re-create it now that the failing allocation is
@@ -542,7 +646,8 @@ class ContinuousEngine(MeshEngine):
                 slot.sink.put(self._chunk(slot, {"role": "assistant"}))
             self._install(lane, slots, slot)
         except Exception as e:  # noqa: BLE001 — per-request isolation
-            if item.future is not None:
+            self._note_error(e)
+            if item.future is not None and not item.future.done():
                 item.future.set_exception(e)
             elif item.sink is not None:
                 item.sink.put(e)
@@ -556,6 +661,7 @@ class ContinuousEngine(MeshEngine):
         try:
             slot.first_token = int(slot.first_token)
         except Exception as e:  # noqa: BLE001 — per-request isolation
+            self._note_error(e)
             slot.finished = True
             self._free_lane(lane, slot, slots, claim=False)
             if slot.sink is not None:
@@ -631,6 +737,10 @@ class ContinuousEngine(MeshEngine):
         if cut != -1:
             text = text[:cut]
             finish = "stop"
+        if slot.future.done():
+            # resolved externally (watchdog fail_inflight / deadline) while
+            # this chunk was in flight: the result has nowhere to go
+            return
         slot.future.set_result({
             "lfkt_timings": timings,
             "id": slot.cid,
@@ -701,6 +811,7 @@ class ContinuousEngine(MeshEngine):
         except Exception as e:  # noqa: BLE001 — per-request isolation: a
             item = adm["item"]  # failed admission must not kill the scheduler
             self._adm = None
+            self._note_error(e)
             if item.future is not None:
                 item.future.set_exception(e)
             elif item.sink is not None:
@@ -767,22 +878,33 @@ class ContinuousEngine(MeshEngine):
         ``chunk[:counts[l], l]`` — rows beyond that are samples conditioned
         on rejected draft tokens and must be discarded."""
         stop_ids = self.tokenizer.stop_ids
+        now = time.time()
         for lane in range(len(pre)):
             slot = pre[lane]
             if slot is None or slot.finished:
                 continue
-            if slot.abandoned.is_set() or (
+            expired = slot.deadline is not None and now > slot.deadline
+            if expired or slot.abandoned.is_set() or (
+                    slot.abort is not None and slot.abort()) or (
                     slot.future is not None and slot.future.cancelled()):
                 # checked BEFORE materializing a deferred first token: an
                 # abandoned slot's stream would otherwise be opened (role
-                # chunk nobody reads) at the cost of a blocking int() fetch
+                # chunk nobody reads) at the cost of a blocking int() fetch.
+                # Deadline expiry rides the same path: the lane frees at
+                # this chunk boundary instead of decoding to budget.
                 slot.finished = True
+                exc = DeadlineExceeded(
+                    "request deadline expired mid-generation") if expired \
+                    else None
                 if slot.sink is not None:
-                    slot.sink.put(_STREAM_END)
+                    slot.sink.put(exc if exc is not None else _STREAM_END)
                 elif not slot.future.done():
                     # resolve so a caller still awaiting (e.g. via
-                    # asyncio.wrap_future) unblocks as cancelled
-                    slot.future.set_exception(CancelledError())
+                    # asyncio.wrap_future) unblocks as cancelled/timed out
+                    if exc is not None:
+                        slot.future.set_exception(exc)
+                    else:
+                        slot.future.set_exception(CancelledError())
                 self._free_lane(lane, slot, slots)
                 continue
             if slot.pending_first:
@@ -913,6 +1035,7 @@ class ContinuousEngine(MeshEngine):
 
                 if any(s is not None for s in slots):
                     pre = list(slots)   # lanes live in THIS chunk
+                    FAULTS.fire("decode_step")
                     self._bstate, toks = batched_generate_chunk_perlane_jit(
                         self.params, self.cfg, self._bstate, self._lane_st,
                         n_steps=self.decode_chunk, top_k=self._max_top_k)
@@ -942,8 +1065,16 @@ class ContinuousEngine(MeshEngine):
                     "pending": self._pending.qsize(),
                     "admission_inflight": int(self._adm is not None),
                 }
+                # watchdog pulse: a beat per loop iteration, busy = queued +
+                # occupied work.  A loop wedged inside a device call stops
+                # beating with busy > 0 — the stall signature.
+                self.heartbeat.beat()
+                self.heartbeat.set_busy(
+                    self._stats["lanes_live"] + self._stats["pending"]
+                    + self._stats["admission_inflight"])
         except BaseException as e:  # noqa: BLE001 — fail all, loudly
             self._loop_error = e
+            self.heartbeat.record_error(e)
             logger.exception("scheduler loop died")
         finally:
             # graceful stop AND crash both resolve every outstanding request:
@@ -978,3 +1109,4 @@ class ContinuousEngine(MeshEngine):
             # dashboards built on them
             self._stats = {"lanes_live": 0, "pending": 0,
                            "admission_inflight": 0}
+            self.heartbeat.set_busy(0)

@@ -42,6 +42,8 @@ from ..models.llama import init_cache
 from ..models.params import load_params, synth_params
 from ..sampling.sample import SamplingParams, sampling_tensors, seed_window
 from ..tokenizer import apply_chat_template, detect_chat_template, tokenizer_from_gguf
+from ..utils.faults import FAULTS
+from ..utils.health import Heartbeat
 from ..utils.jaxcache import setup_compile_cache
 from ..utils.tracing import maybe_profile
 
@@ -136,9 +138,15 @@ class Engine:
         *,
         _parts: tuple | None = None,  # (params, cfg, tokenizer, template_kind)
     ):
+        FAULTS.fire("load")   # injection point: weight-load / re-init failure
         self.n_ctx = n_ctx
         self.decode_chunk = decode_chunk
         self.max_gen_tokens = max_gen_tokens
+        #: progress pulse for the engine watchdog (engine/watchdog.py):
+        #: one beat per device step, busy brackets around generations,
+        #: an error ring for burst detection.  Engines never import the
+        #: watchdog — this object is the entire interface.
+        self.heartbeat = Heartbeat()
         if spec_decode not in ("off", "lookup", "auto"):
             raise ValueError(
                 f"spec_decode must be off|lookup|auto, got {spec_decode!r}")
@@ -452,6 +460,54 @@ class Engine:
         with self._id_lock:
             self.last_timings = timings
 
+    def _note_error(self, exc: BaseException) -> None:
+        """Record an engine-side failure on the heartbeat for the watchdog's
+        burst detector.  ValueError is a *client* input error (oversized
+        prompt, bad params) — a burst of bad requests must never count as
+        engine failure, or abusive traffic could trip the watchdog."""
+        if isinstance(exc, ValueError):
+            return
+        self.heartbeat.record_error(exc)
+
+    # -- watchdog recovery ---------------------------------------------
+    def recover(self) -> bool:
+        """Re-initialize serving state after a watchdog trip (bounded
+        recovery, engine/watchdog.py).  The serial engine's mutable state
+        is the KV ring and its prefix claim; params are immutable so a
+        fresh ring is a full re-init.  Refuses (returns False) while a
+        generation holds the lock — the cache cannot be swapped under a
+        live decode, and a permanently held lock means a wedged device
+        call, which only a pod restart (DEAD) clears."""
+        FAULTS.fire("recover")   # injection point: recovery that fails
+        if not self._lock.acquire(blocking=False):
+            return False
+        try:
+            self._recover_locked()
+            self.heartbeat.reset()
+            return True
+        finally:
+            self._lock.release()
+
+    def _recover_locked(self) -> None:
+        """Engine-specific state re-init, called with the lock held."""
+        self._cache = init_cache(self.cfg)
+        self._prefix_ids = []
+
+    @staticmethod
+    def _deadline_hit(ctx) -> bool:
+        """Per-request deadline/abort propagation: True when the caller's
+        deadline passed or its abort callback fired — the decode loops
+        check this once per chunk so a timed-out or disconnected request
+        abandons the device within one decode step instead of generating
+        to budget (the reference's engine always ran to completion,
+        api.py:97-100, which only its strictly serial engine could
+        afford)."""
+        abort = ctx.get("abort")
+        if abort is not None and abort():
+            return True
+        deadline = ctx.get("deadline")
+        return deadline is not None and time.time() > deadline
+
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
             if n <= b:
@@ -476,10 +532,18 @@ class Engine:
         max_tokens: int | None = None,
         stop: Sequence[str] | str | None = None,
         seed: int | None = None,
+        deadline: float | None = None,
+        abort=None,
     ):
         """OpenAI-chat-shaped completion (dict), or an iterator of chunks when
         ``stream=True`` (reference call site: api.py:55-63; chunk schema per
-        SURVEY.md §2B "Streaming").  Safe to call from a worker thread."""
+        SURVEY.md §2B "Streaming").  Safe to call from a worker thread.
+
+        ``deadline`` (absolute ``time.time()`` seconds) and ``abort`` (a
+        callable returning True when the caller gave up) propagate the
+        server's admission timeout/disconnect into the decode loop: the
+        generation stops within one decode chunk of either firing, with
+        ``finish_reason="deadline"``."""
         if stop is None:
             stop = []
         elif isinstance(stop, str):
@@ -490,13 +554,17 @@ class Engine:
             repeat_penalty=repeat_penalty,
         )
         if stream:
-            return self._generate_stream(messages, sp, max_tokens, stop, seed)
-        return self._generate(messages, sp, max_tokens, stop, seed)
+            return self._generate_stream(messages, sp, max_tokens, stop, seed,
+                                         deadline=deadline, abort=abort)
+        return self._generate(messages, sp, max_tokens, stop, seed,
+                              deadline=deadline, abort=abort)
 
     # ------------------------------------------------------------------
     def _start(self, messages, sp: SamplingParams, seed):
         """Shared prefill + first-token path. Returns a mutable gen context."""
         t0 = time.time()
+        self.heartbeat.beat()
+        FAULTS.fire("prefill")
         ids = self.tokenize_messages(messages)
         n_prompt = len(ids)
         if n_prompt >= self.cfg.n_ctx:
@@ -729,6 +797,11 @@ class Engine:
         if ready:
             yield ready, False, finish
         while not done:
+            if self._deadline_hit(ctx):
+                finish = "deadline"
+                break
+            self.heartbeat.beat()
+            FAULTS.fire("decode_step")
             remaining = budget - len(gen)
             capacity = self.cfg.n_ctx - pos - 1   # cache slots left to write
             draft = (self._lookup_draft(history, D)
@@ -822,6 +895,11 @@ class Engine:
         if ready:
             yield ready, False, finish
         while not done:
+            if self._deadline_hit(ctx):
+                finish = "deadline"   # caller timed out/disconnected: free
+                break                 # the device within one decode chunk
+            self.heartbeat.beat()
+            FAULTS.fire("decode_step")
             # dispatch the NEXT chunk before touching the host copy of the
             # current one (speculating that no stop token appears)
             pos += n_cur
@@ -850,40 +928,64 @@ class Engine:
         yield tail, True, finish
 
     # ------------------------------------------------------------------
-    def _generate(self, messages, sp, max_tokens, stops, seed) -> dict:
+    def _generate(self, messages, sp, max_tokens, stops, seed,
+                  deadline=None, abort=None) -> dict:
         with self._lock, maybe_profile("generate"):
-            t0 = time.time()
-            ctx = self._start(messages, sp, seed)
-            parts = []
-            finish = "stop"
-            for text, done, fr in self._run(ctx, max_tokens, stops):
-                parts.append(text)
-                finish = fr
-            timings = self._finish(ctx)
-            content = "".join(parts)
-            completion_tokens = len(ctx["ids"])
-            logger.info("generation: %.2fs, finish=%s", time.time() - t0, finish)
-            return {
-                "lfkt_timings": timings,
-                "id": f"chatcmpl-{uuid.uuid4().hex}",
-                "object": "chat.completion",
-                "created": int(time.time()),
-                "model": self.model_name,
-                "choices": [{
-                    "index": 0,
-                    "message": {"role": "assistant", "content": content},
-                    "finish_reason": finish,
-                }],
-                "usage": {
-                    "prompt_tokens": ctx["n_prompt"],
-                    "completion_tokens": completion_tokens,
-                    "total_tokens": ctx["n_prompt"] + completion_tokens,
-                },
-            }
+            self.heartbeat.enter()
+            try:
+                return self._generate_locked(messages, sp, max_tokens, stops,
+                                             seed, deadline, abort)
+            except Exception as e:  # noqa: BLE001 — burst detection, re-raised
+                self._note_error(e)
+                raise
+            finally:
+                self.heartbeat.leave()
 
-    def _generate_stream(self, messages, sp, max_tokens, stops, seed) -> Iterator[dict]:
+    def _generate_locked(self, messages, sp, max_tokens, stops, seed,
+                         deadline, abort) -> dict:
+        t0 = time.time()
+        ctx = self._start(messages, sp, seed)
+        ctx["deadline"] = deadline
+        ctx["abort"] = abort
+        parts = []
+        finish = "stop"
+        for text, done, fr in self._run(ctx, max_tokens, stops):
+            parts.append(text)
+            finish = fr
+        timings = self._finish(ctx)
+        content = "".join(parts)
+        completion_tokens = len(ctx["ids"])
+        logger.info("generation: %.2fs, finish=%s", time.time() - t0, finish)
+        return {
+            "lfkt_timings": timings,
+            "id": f"chatcmpl-{uuid.uuid4().hex}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": content},
+                "finish_reason": finish,
+            }],
+            "usage": {
+                "prompt_tokens": ctx["n_prompt"],
+                "completion_tokens": completion_tokens,
+                "total_tokens": ctx["n_prompt"] + completion_tokens,
+            },
+        }
+
+    def _generate_stream(self, messages, sp, max_tokens, stops, seed,
+                         deadline=None, abort=None) -> Iterator[dict]:
         with self._lock:
-            ctx = self._start(messages, sp, seed)
+            self.heartbeat.enter()
+            try:
+                ctx = self._start(messages, sp, seed)
+            except Exception as e:  # noqa: BLE001 — burst detection, re-raised
+                self.heartbeat.leave()
+                self._note_error(e)
+                raise
+            ctx["deadline"] = deadline
+            ctx["abort"] = abort
             cid = f"chatcmpl-{uuid.uuid4().hex}"
             created = int(time.time())
 
@@ -911,7 +1013,11 @@ class Engine:
                 final = chunk({}, finish=finish)
                 final["lfkt_timings"] = timings
                 yield final
+            except Exception as e:  # noqa: BLE001 — burst detection, re-raised
+                self._note_error(e)
+                raise
             finally:
+                self.heartbeat.leave()
                 if not finished:
                     # generator closed early (client gone): _finish must
                     # still run or self._cache would keep pointing at the

@@ -4,6 +4,13 @@ Implements the §2B response contract (SURVEY.md) with injectable latency and
 failures so the admission-control paths — queue-full 503, 25 s timeout 408,
 engine-error 500 (reference api.py:155-173) — can be exercised without a
 model or a device (SURVEY.md §4 "Integration").
+
+Resilience-aware since the watchdog PR: carries a real
+:class:`~..utils.health.Heartbeat`, honors the ``decode_step`` fault
+injection point (utils/faults.py — inert unless armed), and implements the
+watchdog recovery contract (``recover``/``fail_inflight``), so the full
+trip → DEGRADED → recover → READY path is drillable against a live server
+with no model (tools/fault_drill.py, tests/test_resilience.py).
 """
 
 from __future__ import annotations
@@ -11,6 +18,9 @@ from __future__ import annotations
 import threading
 import time
 import uuid
+
+from ..utils.faults import FAULTS
+from ..utils.health import Heartbeat
 
 
 class FakeEngine:
@@ -22,17 +32,40 @@ class FakeEngine:
         self.chunk_delay = chunk_delay   # slow-drip streaming (deadline tests)
         self.calls: list[list[dict]] = []
         self._lock = threading.Lock()
+        self.heartbeat = Heartbeat()
+        self.recoveries = 0              # recover() invocations (assertable)
+        self.failed_inflight: list = []  # exceptions from fail_inflight
 
     def warmup(self):
         pass
 
+    # -- watchdog contract (engine/watchdog.py) -------------------------
+    def recover(self) -> bool:
+        FAULTS.fire("recover")
+        self.recoveries += 1
+        self.heartbeat.reset()
+        return True
+
+    def fail_inflight(self, exc: BaseException) -> None:
+        self.failed_inflight.append(exc)
+
     def create_chat_completion(self, messages, stream=False, **kwargs):
         with self._lock:
             self.calls.append(list(messages))
-        if self.delay:
-            time.sleep(self.delay)
-        if self.fail is not None:
-            raise self.fail
+        self.heartbeat.enter()
+        try:
+            if self.delay:
+                time.sleep(self.delay)
+            try:
+                FAULTS.fire("decode_step")
+                if self.fail is not None:
+                    raise self.fail
+            except Exception as e:  # noqa: BLE001 — burst detection, re-raised
+                self.heartbeat.record_error(e)
+                raise
+            self.heartbeat.beat()
+        finally:
+            self.heartbeat.leave()
         content = self.reply
         base = {
             "id": f"chatcmpl-{uuid.uuid4().hex}",
@@ -59,6 +92,7 @@ class FakeEngine:
             for ch in content:
                 if self.chunk_delay:
                     time.sleep(self.chunk_delay)
+                self.heartbeat.beat()
                 yield {**base, "object": "chat.completion.chunk",
                        "choices": [{"index": 0, "delta": {"content": ch},
                                     "finish_reason": None}]}

@@ -61,6 +61,15 @@ class SPEngine(Engine):
         logger.info("SPEngine: n_ctx=%d over sp=%d tp=%d (%d devices)",
                     self.cfg.n_ctx, sp, tp, sp * tp)
 
+    def _recover_locked(self) -> None:
+        """Watchdog recovery: the fresh ring must carry the same sp-sharded
+        layout __init__ installed — the base class's unsharded init_cache
+        would replicate the full n_ctx ring per device, defeating the
+        reason sp exists (HBM) on the first post-recovery request."""
+        super()._recover_locked()
+        self._cache = jax.device_put(
+            init_cache(self.cfg), sp_state_shardings(self.cfg, self.mesh))
+
     # -- jit call points rerouted onto the mesh -----------------------------
     def _prefill_call(self, tokens, length, cache):
         return sp_prefill(self.params, self.cfg, tokens, length, cache,

@@ -33,6 +33,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
+from ...gguf.quants import _garbage_tolerant
 from ...gguf.quants import unpack_scale_min_k4
 from .qmatmul import (
     augment_x,
@@ -116,6 +117,7 @@ def _combine_q5p(q5s: np.ndarray, q5h: np.ndarray, n_out: int,
     return out.reshape(n_out, k_in)
 
 
+@_garbage_tolerant
 def prep_q5k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
     """Raw Q5_K block bytes (row-major, ``n_out`` rows of ``k_in`` elements)
     → the kernel layout dict: {"q5s", "q5h", "sm5"} (split layout) or

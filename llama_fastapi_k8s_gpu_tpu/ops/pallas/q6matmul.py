@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
+from ...gguf.quants import _garbage_tolerant
 from .qmatmul import (
     batched_rows,
     def_partition_compat,
@@ -120,6 +121,7 @@ def _combine_q6p(q4: np.ndarray, q2: np.ndarray, n_out: int,
     return (nib + (crumb << 4).astype(np.int8)).reshape(n_out, k_in)
 
 
+@_garbage_tolerant
 def prep_q6k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
     """Raw Q6_K block bytes (row-major, ``n_out`` rows of ``k_in`` elements)
     → the kernel layout dict: {"q4", "q2", "sm6"} (split layout) or

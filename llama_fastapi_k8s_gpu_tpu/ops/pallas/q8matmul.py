@@ -35,6 +35,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType
+from ...gguf.quants import _garbage_tolerant
 from .qmatmul import (
     batched_rows,
     def_partition_compat,
@@ -55,6 +56,7 @@ from .qmatmul import (
 q8_compatible = q4k_compatible  # same divisibility classes
 
 
+@_garbage_tolerant
 def prep_q8_0(raw: np.ndarray, n_out: int, k_in: int) -> dict:
     """Raw Q8_0 block bytes (row-major) → {"q8", "sm8"}."""
     if not q8_compatible(n_out, k_in):

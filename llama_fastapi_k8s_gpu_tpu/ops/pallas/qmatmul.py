@@ -71,7 +71,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
-from ...gguf.quants import unpack_scale_min_k4
+from ...gguf.quants import _garbage_tolerant, unpack_scale_min_k4
 
 TK = 2048            # K elements per kernel step = 8 super-blocks
 _SUBS = TK // 32     # 64 sub-blocks per k-tile
@@ -98,6 +98,7 @@ def q4k_compatible(n_out: int, k_in: int, for_tpu: bool | None = None) -> bool:
 # host-side weight prep
 # ---------------------------------------------------------------------------
 
+@_garbage_tolerant
 def prep_q4k(raw: np.ndarray, n_out: int, k_in: int) -> dict:
     """Raw Q4_K block bytes (row-major, ``n_out`` rows of ``k_in`` elements)
     → the kernel layout dict {"qs", "sm"}.

@@ -22,6 +22,7 @@ Additions the reference advertises but lacks (SURVEY.md §2C): ``GET /health``
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import time
 from datetime import datetime
@@ -30,6 +31,7 @@ import json
 
 from .asgikit import (
     HTTPException,
+    JSONResponse,
     MicroAPI,
     PlainTextResponse,
     Request,
@@ -37,6 +39,15 @@ from .asgikit import (
 )
 
 from ..utils.config import Settings, get_settings
+from ..utils.faults import FAULTS
+from ..utils.health import (
+    READY,
+    STARTING,
+    STATE_CODES,
+    DeadlineExceeded,
+    EngineUnavailable,
+    HealthMonitor,
+)
 from ..utils.metrics import Metrics
 from .schemas import BotMessageRequest
 
@@ -44,6 +55,19 @@ logging.basicConfig(level=logging.INFO)
 logger = logging.getLogger(__name__)
 
 _STREAM_DONE = object()  # consumer→handler sentinel: stream finished cleanly
+
+
+def _accepts_kwarg(fn, name: str) -> bool:
+    """True when ``fn`` takes ``name`` (or **kwargs) — engines grew the
+    deadline/abort kwargs in the resilience PR, but test fakes and
+    out-of-tree engines may predate them; probe once instead of failing
+    every request."""
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    return name in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
 
 
 def count_tokens_roughly(text: str) -> int:
@@ -103,6 +127,11 @@ def create_app(engine=None, settings: Settings | None = None,
     app.state.engine = engine
     app.state.metrics = Metrics()
     app.state.ready = engine is not None
+    #: pod health state machine (utils/health.py): STARTING until the
+    #: engine is loaded; the watchdog moves it between READY/DEGRADED/DEAD
+    app.state.health = HealthMonitor()
+    app.state.watchdog = None
+    app.state.engine_kw = {}   # which resilience kwargs the engine accepts
     # strong refs to fire-and-forget tasks: the loop holds only weak refs,
     # so an unreferenced task can be garbage-collected mid-flight (losing
     # its inflight permit and stranding its caller)
@@ -172,7 +201,7 @@ def create_app(engine=None, settings: Settings | None = None,
                 # batched shapes, so even solo requests must use them
                 try:
                     responses = await _truncate_and_generate_batch(
-                        [rd["messages"] for rd in live], semaphore)
+                        live, semaphore)
                     results = [
                         (rd, None, r) if isinstance(r, Exception) else (rd, r, None)
                         for rd, r in zip(live, responses)
@@ -183,7 +212,7 @@ def create_app(engine=None, settings: Settings | None = None,
                 for rd in live:     # per-request isolation (reference semantics)
                     try:
                         results.append((rd, await _truncate_and_generate(
-                            rd["messages"], semaphore), None))
+                            rd, semaphore), None))
                     except Exception as e:  # noqa: BLE001
                         results.append((rd, None, e))
             for rd, resp, err in results:
@@ -243,35 +272,60 @@ def create_app(engine=None, settings: Settings | None = None,
         return "".join(c["message"]["content"]
                        for c in answer.get("choices", []) if "message" in c)
 
-    async def _truncate_and_generate(messages, semaphore) -> str:
+    def _resilience_kw(rd) -> dict:
+        """Deadline/abort propagation kwargs for engines that accept them:
+        the request's admission deadline and a did-the-caller-give-up
+        callback, so a timed-out or disconnected request frees the engine
+        within one decode step (the reference decoded to budget)."""
+        kw = {}
+        if app.state.engine_kw.get("deadline"):
+            kw["deadline"] = rd.get("deadline")
+        if app.state.engine_kw.get("abort"):
+            kw["abort"] = rd["future"].cancelled
+        return kw
+
+    async def _truncate_and_generate(rd, semaphore) -> str:
         m = app.state.metrics
         async with semaphore:  # one generation at a time (reference api.py:50)
             try:
                 messages = truncate_messages_to_fit_context(
-                    messages, settings.max_context_tokens)
+                    rd["messages"], settings.max_context_tokens)
                 t0 = time.time()
                 answer = await asyncio.to_thread(
-                    app.state.engine.create_chat_completion,
-                    messages=messages,
-                    stream=False,
-                    temperature=settings.temperature,
-                    top_p=settings.top_p,
-                    frequency_penalty=settings.frequency_penalty,
-                    presence_penalty=settings.presence_penalty,
-                )
+                    lambda: app.state.engine.create_chat_completion(
+                        messages=messages,
+                        stream=False,
+                        temperature=settings.temperature,
+                        top_p=settings.top_p,
+                        frequency_penalty=settings.frequency_penalty,
+                        presence_penalty=settings.presence_penalty,
+                        **_resilience_kw(rd),
+                    ))
                 m.observe("generation_seconds", time.time() - t0)
                 _observe_engine_timings(m, answer)
                 return _answer_to_text(answer, m)
             except HTTPException:
                 raise
+            except EngineUnavailable as e:
+                # watchdog trip / recovery in progress: retryable 503, not
+                # the "this request hit a bug" 500
+                m.inc("engine_unavailable_total")
+                logger.error("Engine unavailable: %s", e)
+                raise HTTPException(
+                    status_code=503, detail=f"Engine unavailable: {e}") from e
+            except DeadlineExceeded as e:
+                m.inc("requests_timed_out_total")
+                raise HTTPException(
+                    status_code=408, detail="Generation timed out") from e
             except Exception as e:  # noqa: BLE001 — 500 semantics, api.py:76-78
+                m.inc("engine_errors_total")
                 logger.error("Error during message generation: %s", e)
                 raise HTTPException(
                     status_code=500,
                     detail=f"Error during message generation: {str(e)}",
                 ) from e
 
-    async def _truncate_and_generate_batch(batch_messages, semaphore):
+    async def _truncate_and_generate_batch(rds, semaphore):
         """Batched analogue of ``_truncate_and_generate`` over MeshEngine.
         Returns one entry per request: the response text, or an exception for
         that request alone (per-entry engine errors don't fail neighbors)."""
@@ -279,18 +333,27 @@ def create_app(engine=None, settings: Settings | None = None,
         async with semaphore:
             try:
                 batch_messages = [
-                    truncate_messages_to_fit_context(ms, settings.max_context_tokens)
-                    for ms in batch_messages
+                    truncate_messages_to_fit_context(rd["messages"],
+                                                     settings.max_context_tokens)
+                    for rd in rds
                 ]
+                batch_kw = {}
+                if app.state.engine_kw.get("batch_deadlines"):
+                    # per-entry deadline/abort propagation: an entry whose
+                    # caller timed out or disconnected stops accumulating
+                    # within one decode chunk instead of pinning the cycle
+                    batch_kw["deadlines"] = [rd.get("deadline") for rd in rds]
+                    batch_kw["aborts"] = [rd["future"].cancelled for rd in rds]
                 t0 = time.time()
                 answers = await asyncio.to_thread(
-                    app.state.engine.create_chat_completions,
-                    batch_messages,
-                    temperature=settings.temperature,
-                    top_p=settings.top_p,
-                    frequency_penalty=settings.frequency_penalty,
-                    presence_penalty=settings.presence_penalty,
-                )
+                    lambda: app.state.engine.create_chat_completions(
+                        batch_messages,
+                        temperature=settings.temperature,
+                        top_p=settings.top_p,
+                        frequency_penalty=settings.frequency_penalty,
+                        presence_penalty=settings.presence_penalty,
+                        **batch_kw,
+                    ))
                 m.observe("generation_seconds", time.time() - t0)
                 m.inc("batched_generations_total")
                 m.observe("batch_occupancy", len(batch_messages))
@@ -311,7 +374,13 @@ def create_app(engine=None, settings: Settings | None = None,
                     except HTTPException as e:
                         out.append(e)
                 return out
+            except EngineUnavailable as e:
+                m.inc("engine_unavailable_total")
+                logger.error("Engine unavailable: %s", e)
+                raise HTTPException(
+                    status_code=503, detail=f"Engine unavailable: {e}") from e
             except Exception as e:  # noqa: BLE001 — 500 semantics, api.py:76-78
+                m.inc("engine_errors_total")
                 logger.error("Error during batched generation: %s", e)
                 raise HTTPException(
                     status_code=500,
@@ -345,12 +414,16 @@ def create_app(engine=None, settings: Settings | None = None,
                     rd["messages"], settings.max_context_tokens)
                 t0 = time.time()
                 engine = app.state.engine
+                sub_kw = {}
+                if app.state.engine_kw.get("submit_deadline"):
+                    sub_kw["deadline"] = rd.get("deadline")
                 engine_fut = engine.submit(
                     messages,
                     temperature=settings.temperature,
                     top_p=settings.top_p,
                     frequency_penalty=settings.frequency_penalty,
                     presence_penalty=settings.presence_penalty,
+                    **sub_kw,
                 )
                 if hasattr(engine, "abandon"):
                     rd["future"].add_done_callback(
@@ -363,7 +436,19 @@ def create_app(engine=None, settings: Settings | None = None,
                 err = None
             except HTTPException as e:
                 result, err = None, e
+            except EngineUnavailable as e:
+                # watchdog trip failed this future / scheduler restarting:
+                # retryable 503 (the reference's only answer was pod death)
+                m.inc("engine_unavailable_total")
+                logger.error("Engine unavailable: %s", e)
+                result, err = None, HTTPException(
+                    status_code=503, detail=f"Engine unavailable: {e}")
+            except DeadlineExceeded:
+                m.inc("requests_timed_out_total")
+                result, err = None, HTTPException(
+                    status_code=408, detail="Generation timed out")
             except Exception as e:  # noqa: BLE001 — 500 semantics, api.py:76-78
+                m.inc("engine_errors_total")
                 logger.error("Error during message generation: %s", e)
                 result, err = None, HTTPException(
                     status_code=500,
@@ -384,10 +469,12 @@ def create_app(engine=None, settings: Settings | None = None,
         ``semaphore=None`` (continuous mode) streams through a scheduler
         lane with no global serialization.  When the client abandons the
         stream (timeout/disconnect cancels ``rd["future"]``) the engine
-        iterator is closed, which frees the lane at the next chunk boundary;
-        serial engines instead run to completion with chunks dropped — the
-        reference's no-mid-generation-abort behavior (api.py:97-100), which
-        costs nobody there because its engine is serial anyway."""
+        iterator is closed, which frees the lane/slot at the next chunk
+        boundary — on EVERY engine: serial engines used to run to
+        completion with chunks dropped (the reference's
+        no-mid-generation-abort behavior, api.py:97-100, affordable only
+        because its engine idles anyway), but a serial engine here blocks
+        the whole consumer while it decodes to budget for nobody."""
         m = app.state.metrics
         chunk_q = rd["stream_queue"]
         loop = asyncio.get_running_loop()
@@ -396,7 +483,6 @@ def create_app(engine=None, settings: Settings | None = None,
         async def _go():
             messages = truncate_messages_to_fit_context(
                 rd["messages"], settings.max_context_tokens)
-            abandonable = hasattr(app.state.engine, "submit_stream")
 
             def run():
                 try:
@@ -406,10 +492,11 @@ def create_app(engine=None, settings: Settings | None = None,
                         temperature=settings.temperature,
                         top_p=settings.top_p,
                         frequency_penalty=settings.frequency_penalty,
-                        presence_penalty=settings.presence_penalty)
+                        presence_penalty=settings.presence_penalty,
+                        **_resilience_kw(rd))
                     try:
                         for chunk in it:
-                            if abandonable and rd["future"].cancelled():
+                            if rd["future"].cancelled():
                                 return   # closes it → engine frees the lane
                             t = chunk.pop("lfkt_timings", None)
                             if t is not None:
@@ -442,12 +529,47 @@ def create_app(engine=None, settings: Settings | None = None,
         # continuous mode: at most batch_size forwarded-but-unfinished
         # requests, so the bounded queue stays the back-pressure surface
         app.state.inflight = asyncio.Semaphore(max(1, settings.batch_size))
+        app.state.health.transition(STARTING, "model loading")
         if app.state.engine is None:
             factory = engine_factory or _default_engine_factory(settings)
             loop = asyncio.get_running_loop()
             app.state.engine = await loop.run_in_executor(None, factory)
+        engine = app.state.engine
+        # which resilience kwargs this engine accepts (probed once; fakes
+        # and out-of-tree engines may predate the deadline/abort contract)
+        ccc = getattr(engine, "create_chat_completion", None)
+        app.state.engine_kw = {
+            "deadline": ccc is not None and _accepts_kwarg(ccc, "deadline"),
+            "abort": ccc is not None and _accepts_kwarg(ccc, "abort"),
+            "submit_deadline": hasattr(engine, "submit") and _accepts_kwarg(
+                engine.submit, "deadline"),
+            "batch_deadlines": hasattr(engine, "create_chat_completions")
+            and _accepts_kwarg(engine.create_chat_completions, "deadlines"),
+        }
         app.state.ready = True
+        app.state.health.transition(READY, "engine loaded")
+        if settings.watchdog and getattr(engine, "heartbeat", None) is not None:
+            # local import: engine.watchdog pulls the (jax-heavy) engine
+            # package, which this module otherwise defers to the factory
+            from ..engine.watchdog import Watchdog
+
+            app.state.watchdog = Watchdog(
+                engine, app.state.health, app.state.metrics,
+                stall_seconds=settings.watchdog_stall_seconds,
+                poll_seconds=settings.watchdog_poll_seconds,
+                max_recoveries=settings.watchdog_max_recoveries,
+                error_burst=settings.watchdog_error_burst,
+                error_window=settings.watchdog_error_window,
+                backoff_seconds=settings.watchdog_backoff_seconds,
+                backoff_max=settings.watchdog_backoff_max,
+            ).start()
         app.state.consumer_task = asyncio.create_task(consumer())
+
+    @app.on_event("shutdown")
+    async def shutdown_event():
+        if app.state.watchdog is not None:
+            app.state.watchdog.stop()
+            app.state.watchdog = None
 
     def _admit(request_body: BotMessageRequest, request: Request,
                extra: dict | None = None) -> dict:
@@ -463,10 +585,19 @@ def create_app(engine=None, settings: Settings | None = None,
         system_prompt = build_system_prompt(request_body.bot_profile)
         messages.insert(1, {"role": "system", "content": system_prompt})
 
+        now = time.time()
+        # per-request deadline: the admission timeout (or the stream's
+        # wall-clock budget) becomes an absolute deadline threaded into the
+        # engine (deadline propagation), so a timed-out request frees its
+        # lane/slot within one decode step instead of generating for nobody
+        budget = (settings.stream_deadline_seconds
+                  if extra and "stream_queue" in extra
+                  else settings.timeout_seconds)
         rd = {
             "messages": messages,
             "future": asyncio.get_running_loop().create_future(),
-            "enqueued_at": time.time(),
+            "enqueued_at": now,
+            "deadline": now + budget,
             **(extra or {}),
         }
         try:
@@ -515,35 +646,91 @@ def create_app(engine=None, settings: Settings | None = None,
         deadline = loop.time() + settings.stream_deadline_seconds
 
         async def sse():
-            while True:
-                gap = min(settings.timeout_seconds, deadline - loop.time())
-                try:
-                    if gap <= 0:
-                        raise asyncio.TimeoutError
-                    chunk = await asyncio.wait_for(
-                        rd["stream_queue"].get(), timeout=gap)
-                except asyncio.TimeoutError:
-                    m.inc("requests_timed_out_total")
-                    rd["future"].cancel()   # abandons the lane (continuous)
-                    yield ("data: "
-                           + json.dumps({"error": "Generation timed out"})
-                           + "\n\n")
-                    return
-                if chunk is _STREAM_DONE:
-                    yield "data: [DONE]\n\n"
-                    return
-                if isinstance(chunk, Exception):
-                    yield ("data: "
-                           + json.dumps({"error": str(chunk)}) + "\n\n")
-                    return
-                yield "data: " + json.dumps(chunk) + "\n\n"
+            try:
+                while True:
+                    gap = min(settings.timeout_seconds, deadline - loop.time())
+                    try:
+                        if gap <= 0:
+                            raise asyncio.TimeoutError
+                        chunk = await asyncio.wait_for(
+                            rd["stream_queue"].get(), timeout=gap)
+                    except asyncio.TimeoutError:
+                        m.inc("requests_timed_out_total")
+                        yield ("data: "
+                               + json.dumps({"error": "Generation timed out"})
+                               + "\n\n")
+                        return
+                    if chunk is _STREAM_DONE:
+                        yield "data: [DONE]\n\n"
+                        return
+                    if isinstance(chunk, Exception):
+                        yield ("data: "
+                               + json.dumps({"error": str(chunk)}) + "\n\n")
+                        return
+                    yield "data: " + json.dumps(chunk) + "\n\n"
+            finally:
+                # runs on timeout, error, AND client disconnect (the ASGI
+                # layer closes this generator when the transport drops):
+                # cancelling the future is the one signal every engine path
+                # watches, so the lane/slot is reclaimed within one decode
+                # step instead of streaming to a dead socket until budget
+                if not rd["future"].done():
+                    rd["future"].cancel()
 
         return StreamingResponse(sse())
+
+    def _resilience_info() -> dict:
+        """Error-taxonomy + watchdog block for /health: the state machine,
+        the trip/recovery counters, and the last engine error."""
+        st = app.state
+        info: dict = {"health": st.health.snapshot()}
+        wd = st.watchdog
+        if wd is not None:
+            info["watchdog"] = {
+                "trips": wd.trips,
+                "recoveries": wd.recoveries,
+                "max_recoveries": wd.max_recoveries,
+                "last_trip_reason": wd.last_trip_reason,
+                "stall_seconds": wd.stall_seconds,
+            }
+        hb = getattr(st.engine, "heartbeat", None)
+        if hb is not None:
+            info["engine_errors"] = {
+                "total": hb.errors_total,
+                "last": hb.last_error,
+            }
+        if FAULTS.armed():        # drills only: never present in production
+            info["faults_armed"] = FAULTS.stats()
+        return info
+
+    @app.get("/health/ready")
+    async def health_ready():
+        """Readiness probe: 200 only in READY — a DEGRADED or DRAINING pod
+        sheds traffic (503) while staying alive.  Helm's readinessProbe
+        and startupProbe point here (helm/templates/deployment.yaml)."""
+        h = app.state.health
+        ok = h.ready()
+        snap = h.snapshot()
+        body = {"ready": ok, "state": snap["state"], "reason": snap["reason"]}
+        return JSONResponse(body, 200 if ok else 503)
+
+    @app.get("/health/live")
+    async def health_live():
+        """Liveness probe: 503 only in DEAD (recovery budget exhausted) —
+        a briefly degraded pod recovering in-process must NOT be killed
+        mid-recovery.  Helm's livenessProbe points here."""
+        h = app.state.health
+        ok = h.alive()
+        snap = h.snapshot()
+        body = {"alive": ok, "state": snap["state"], "reason": snap["reason"]}
+        return JSONResponse(body, 200 if ok else 503)
 
     @app.get("/health")
     async def health():
         """Advertised by the reference README (README.md:14) but never
-        implemented (SURVEY.md §3.5); serves k8s liveness/readiness."""
+        implemented (SURVEY.md §3.5); the operator-facing health document.
+        k8s probes use the split routes (/health/ready, /health/live) so
+        "briefly degraded" and "kill me" are distinct answers."""
         st = app.state
         queue_depth = st.queue.qsize() if hasattr(st, "queue") else None
         if not st.ready:
@@ -582,10 +769,12 @@ def create_app(engine=None, settings: Settings | None = None,
                 engine_info["spec_auto"] = eng.spec_auto_decision
         return {
             "status": "ok",
+            "state": st.health.state,
             "model_loaded": eng is not None,
             "queue_depth": queue_depth,
             "max_queue_size": st.settings.max_queue_size,
             "engine": engine_info,
+            "resilience": _resilience_info(),
         }
 
     @app.get("/metrics")
@@ -593,6 +782,13 @@ def create_app(engine=None, settings: Settings | None = None,
         m = app.state.metrics
         if hasattr(app.state, "queue"):
             m.set_gauge("queue_depth", app.state.queue.qsize())
+        # health/resilience gauges (error taxonomy counters — timeouts,
+        # 503s, watchdog trips/recoveries — are inc'd at their sites)
+        m.set_gauge("health_state", STATE_CODES[app.state.health.state])
+        hb = getattr(app.state.engine, "heartbeat", None)
+        if hb is not None:
+            m.set_gauge("engine_inflight", hb.busy_count())
+            m.set_gauge("engine_error_count", hb.errors_total)
         kv_bytes = getattr(app.state.engine, "kv_cache_bytes", None)
         if kv_bytes is not None:
             m.set_gauge("kv_cache_bytes", kv_bytes)

@@ -332,10 +332,18 @@ class MicroAPI:
                         for k, v in response.headers.items()]
             await send({"type": "http.response.start",
                         "status": response.status_code, "headers": headers})
-            async for chunk in response.chunks():
-                await send({"type": "http.response.body", "body": chunk,
-                            "more_body": True})
-            await send({"type": "http.response.body", "body": b""})
+            chunks = response.chunks()
+            try:
+                async for chunk in chunks:
+                    await send({"type": "http.response.body", "body": chunk,
+                                "more_body": True})
+                await send({"type": "http.response.body", "body": b""})
+            finally:
+                # deterministic close: when a disconnected client makes
+                # send() raise, the app's generator must see GeneratorExit
+                # NOW (its finally reclaims the engine lane/slot), not at
+                # some later garbage-collection pass
+                await chunks.aclose()
             return
         headers = [(b"content-type", response.media_type.encode()),
                    (b"content-length", str(len(response.body)).encode())]

@@ -56,46 +56,66 @@ async def _reject(writer, status: int, detail: str) -> bool:
 async def _handle_request(app, reader, writer, peer, request_line,
                           state) -> bool:
     """Serve one request on an open connection.  Returns False when the
-    connection must close (malformed request or draining)."""
+    connection must close (malformed request, read deadline, or draining)."""
     try:
         method, target, _version = request_line.decode().split()
     except ValueError:
         return False
-    headers = []
-    content_length = None
-    chunked = False
-    while True:
-        line = await reader.readline()
-        if line in (b"\r\n", b"\n", b""):
-            break
-        name, _, value = line.decode().partition(":")
-        name = name.strip().lower()
-        value = value.strip()
-        headers.append((name.encode(), value.encode()))
-        if name == "content-length":
-            try:
-                cl = int(value)
-            except ValueError:      # malformed framing: say so, then close
-                return await _reject(writer, 400, "invalid Content-Length")
-            if cl < 0:
-                return await _reject(writer, 400, "invalid Content-Length")
-            if content_length is not None and cl != content_length:
-                # conflicting lengths (RFC 9112 §6.3: unrecoverable —
-                # never last-one-wins)
-                return await _reject(writer, 400,
-                                     "conflicting Content-Length")
-            content_length = cl
-        elif name == "transfer-encoding":
-            chunked = True
-    if chunked:
-        # chunked request bodies are not implemented; serving the request
-        # with an empty body would leave the chunk stream in the buffer to
-        # be misparsed as the next request line — close (with attribution)
-        # instead
-        return await _reject(writer, 501,
-                             "chunked transfer-coding not supported")
-    content_length = content_length or 0
-    body = await reader.readexactly(content_length) if content_length else b""
+
+    async def _read_head_and_body():
+        headers = []
+        content_length = None
+        chunked = False
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode().partition(":")
+            name = name.strip().lower()
+            value = value.strip()
+            headers.append((name.encode(), value.encode()))
+            if name == "content-length":
+                try:
+                    cl = int(value)
+                except ValueError:  # malformed framing: say so, then close
+                    return await _reject(writer, 400,
+                                         "invalid Content-Length")
+                if cl < 0:
+                    return await _reject(writer, 400,
+                                         "invalid Content-Length")
+                if content_length is not None and cl != content_length:
+                    # conflicting lengths (RFC 9112 §6.3: unrecoverable —
+                    # never last-one-wins)
+                    return await _reject(writer, 400,
+                                         "conflicting Content-Length")
+                content_length = cl
+            elif name == "transfer-encoding":
+                chunked = True
+        if chunked:
+            # chunked request bodies are not implemented; serving the
+            # request with an empty body would leave the chunk stream in
+            # the buffer to be misparsed as the next request line — close
+            # (with attribution) instead
+            return await _reject(writer, 501,
+                                 "chunked transfer-coding not supported")
+        content_length = content_length or 0
+        body = (await reader.readexactly(content_length)
+                if content_length else b"")
+        return headers, body
+
+    # slowloris guard: once the request line has arrived, the rest of the
+    # head + body must finish arriving within the read deadline — a client
+    # dribbling one header byte per minute gets an honest 408 and a closed
+    # socket instead of holding a connection (and, during drain, a slot in
+    # the shutdown accounting) forever
+    try:
+        got = await asyncio.wait_for(_read_head_and_body(),
+                                     state["read_timeout"])
+    except asyncio.TimeoutError:
+        return await _reject(writer, 408, "request read timeout")
+    if got is False:
+        return False                     # _reject already answered
+    headers, body = got
 
     path, _, query = target.partition("?")
     scope = {
@@ -179,11 +199,38 @@ async def _handle_connection(app, reader: asyncio.StreamReader,
     peer = writer.get_extra_info("peername")
     state["conns"].add(writer)
     state["tasks"].add(asyncio.current_task())
+    first_request = True
     try:
         while True:
             if state["draining"]:
                 break   # shutdown: no new requests on this connection
-            request_line = await reader.readline()
+            if first_request:
+                # a FRESH connection must produce a complete request line
+                # within the read deadline — a dribbled partial line would
+                # otherwise dodge the header/body slowloris guard entirely
+                # (it never reaches _handle_request)
+                try:
+                    request_line = await asyncio.wait_for(
+                        reader.readline(), state["read_timeout"])
+                except asyncio.TimeoutError:
+                    await _reject(writer, 408, "request read timeout")
+                    break
+                first_request = False
+            else:
+                # established keep-alive: idling between requests stays
+                # unbounded (as before), but once the first BYTE of a new
+                # request line arrives the rest must complete within the
+                # read deadline — otherwise one cheap valid request would
+                # buy an attacker an unguarded dribble slot
+                lead = await reader.read(1)
+                if not lead:
+                    break
+                try:
+                    request_line = lead + await asyncio.wait_for(
+                        reader.readline(), state["read_timeout"])
+                except asyncio.TimeoutError:
+                    await _reject(writer, 408, "request read timeout")
+                    break
             if not request_line:
                 break
             # count the request from its first complete request line: a
@@ -227,23 +274,31 @@ def _close_conns(state: dict, only_idle: bool):
 async def serve(app, host: str = "0.0.0.0", port: int = 8000,
                 ready_event: asyncio.Event | None = None,
                 stop_event: asyncio.Event | None = None,
-                drain_seconds: float | None = None):
+                drain_seconds: float | None = None,
+                read_timeout: float | None = None):
     """Serve until SIGINT/SIGTERM (or ``stop_event``), then drain.
 
     ``drain_seconds`` defaults to ``LFKT_DRAIN_SECONDS`` (30 — gunicorn's
     graceful_timeout, the reference's termination behavior at
     docker/Dockerfile.app:12; it also bounds the reference-parity 25 s
-    generation timeout with headroom).
+    generation timeout with headroom).  ``read_timeout`` defaults to
+    ``LFKT_READ_TIMEOUT`` (30) — the slowloris guard's header/body read
+    deadline (408 + Connection: close).
     """
-    if drain_seconds is None:
-        # one parse site for the knob (utils/config.py registers it);
+    if drain_seconds is None or read_timeout is None:
+        # one parse site for the knobs (utils/config.py registers them);
         # local import keeps this module's top-level deps stdlib-only
         from ..utils.config import get_settings
 
-        drain_seconds = get_settings().drain_seconds
+        _settings = get_settings()
+        if drain_seconds is None:
+            drain_seconds = _settings.drain_seconds
+        if read_timeout is None:
+            read_timeout = _settings.read_timeout
     await app.router.startup()
     state = {"active": 0, "draining": False, "idle": asyncio.Event(),
-             "conns": set(), "busy": set(), "tasks": set()}
+             "conns": set(), "busy": set(), "tasks": set(),
+             "read_timeout": read_timeout}
     server = await asyncio.start_server(
         lambda r, w: _handle_connection(app, r, w, state), host, port)
     logger.info("httpd listening on %s:%d", host, port)
@@ -262,6 +317,14 @@ async def serve(app, host: str = "0.0.0.0", port: int = 8000,
     async with server:
         await stop.wait()
         state["draining"] = True
+        # surface the drain on the health state machine (readiness flips
+        # to 503 so k8s stops routing while in-flight requests finish);
+        # generic ASGI apps without the resilience layer are untouched
+        health = getattr(getattr(app, "state", None), "health", None)
+        if health is not None:
+            from ..utils.health import DRAINING
+
+            health.transition(DRAINING, "shutdown signal received")
         server.close()            # stop accepting; existing tasks continue
         # one short tick before closing "idle" connections: a request whose
         # bytes are already buffered but whose handler is still parked in

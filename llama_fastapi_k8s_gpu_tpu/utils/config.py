@@ -70,6 +70,26 @@ class Settings:
     # terminationGracePeriodSeconds above it (helm derives grace from the
     # same values knob)
     drain_seconds: float = 30.0
+    # slowloris guard (in-tree httpd): once a request line has arrived the
+    # headers+body must finish arriving within this window, else 408 +
+    # Connection: close instead of holding the socket forever
+    read_timeout: float = 30.0
+
+    # -- resilience layer (docs/RUNBOOK.md "Degraded-mode operations") -----
+    # engine watchdog: detects stalled decode/hung device calls (no beat
+    # for stall_seconds while work is in flight), exception bursts, and a
+    # dead scheduler loop; trips to DEGRADED (readiness 503, liveness 200),
+    # fails in-flight futures with 503, and runs bounded in-process
+    # recovery with exponential backoff — escalating to DEAD (liveness
+    # 503 → pod restart) after watchdog_max_recoveries trips.
+    watchdog: bool = True
+    watchdog_stall_seconds: float = 60.0
+    watchdog_poll_seconds: float = 1.0
+    watchdog_max_recoveries: int = 3
+    watchdog_error_burst: int = 5
+    watchdog_error_window: float = 30.0
+    watchdog_backoff_seconds: float = 1.0
+    watchdog_backoff_max: float = 60.0
 
     # Fixed sampling parameters the reference passes at api.py:59-62; the
     # remaining knobs take llama-cpp-python 0.2.77 defaults (top_k=40,
@@ -152,6 +172,22 @@ def get_settings() -> Settings:
         max_context_tokens=_env("LFKT_MAX_CONTEXT_TOKENS", Settings.max_context_tokens, int),
         timeout_seconds=_env("LFKT_TIMEOUT_SECONDS", Settings.timeout_seconds, float),
         drain_seconds=_env("LFKT_DRAIN_SECONDS", Settings.drain_seconds, float),
+        read_timeout=_env("LFKT_READ_TIMEOUT", Settings.read_timeout, float),
+        watchdog=_env("LFKT_WATCHDOG", Settings.watchdog, bool),
+        watchdog_stall_seconds=_env("LFKT_WATCHDOG_STALL_SECONDS",
+                                    Settings.watchdog_stall_seconds, float),
+        watchdog_poll_seconds=_env("LFKT_WATCHDOG_POLL_SECONDS",
+                                   Settings.watchdog_poll_seconds, float),
+        watchdog_max_recoveries=_env("LFKT_WATCHDOG_MAX_RECOVERIES",
+                                     Settings.watchdog_max_recoveries, int),
+        watchdog_error_burst=_env("LFKT_WATCHDOG_ERROR_BURST",
+                                  Settings.watchdog_error_burst, int),
+        watchdog_error_window=_env("LFKT_WATCHDOG_ERROR_WINDOW",
+                                   Settings.watchdog_error_window, float),
+        watchdog_backoff_seconds=_env("LFKT_WATCHDOG_BACKOFF_SECONDS",
+                                      Settings.watchdog_backoff_seconds, float),
+        watchdog_backoff_max=_env("LFKT_WATCHDOG_BACKOFF_MAX",
+                                  Settings.watchdog_backoff_max, float),
         max_queue_size=_env("LFKT_MAX_QUEUE_SIZE", Settings.max_queue_size, int),
         stream_deadline_seconds=_env("LFKT_STREAM_DEADLINE_SECONDS",
                                      Settings.stream_deadline_seconds, float),

@@ -618,3 +618,98 @@ def test_scratch_none_recovers(cengine):
         temperature=0.0, max_tokens=4)
     assert out["usage"]["completion_tokens"] >= 1
     assert cengine._scratch_cache is not None
+
+
+# ---------------------------------------------------------------------------
+# disconnect/abandon reclaim bound (resilience layer): a dropped caller
+# frees the engine within ~one decode chunk on every engine flavor
+# ---------------------------------------------------------------------------
+
+def test_abandon_stops_decode_within_one_chunk(cengine, monkeypatch):
+    """After a stream is closed, the scheduler may finish the in-flight
+    chunk plus the one pipelined behind it, then must stop dispatching
+    (the abandoned lane is the only live one)."""
+    from llama_fastapi_k8s_gpu_tpu.engine import continuous as cont
+
+    calls = [0]
+    orig = cont.batched_generate_chunk_perlane_jit
+
+    def counting(*a, **kw):
+        calls[0] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(cont, "batched_generate_chunk_perlane_jit", counting)
+    it = cengine.create_chat_completion(MSGS, stream=True, temperature=0.0,
+                                        max_tokens=100)
+    next(it)
+    next(it)
+    at_close = calls[0]
+    it.close()                        # disconnect: abandon the lane
+    # wait for dispatch quiescence (stats lag one loop iteration, so
+    # polling lanes_live alone can read a stale zero mid-admission)
+    deadline = time.time() + 20
+    last, stable_since = calls[0], time.time()
+    while time.time() < deadline:
+        time.sleep(0.05)
+        if calls[0] != last:
+            last, stable_since = calls[0], time.time()
+        elif time.time() - stable_since > 0.5:
+            break
+    assert cengine.scheduler_stats()["lanes_live"] == 0
+    # in-flight + one pipelined chunk is the contract; slack for chunks
+    # dispatched between the counter read and close() taking effect
+    assert calls[0] - at_close <= 4, (calls[0], at_close)
+
+
+def test_serial_stream_close_stops_decode_immediately(tmp_path):
+    """Engine (serial): closing the stream iterator dispatches no further
+    decode chunk — the generator dies at its yield point."""
+    path = str(tmp_path / "tiny-close.gguf")
+    write_tiny_llama_gguf(path)
+    eng = Engine(path, n_ctx=128, decode_chunk=4, max_gen_tokens=100,
+                 prefill_buckets=(32, 64, 128))
+    calls = [0]
+    orig = eng._decode_chunk_call
+
+    def counting(*a, **kw):
+        calls[0] += 1
+        return orig(*a, **kw)
+
+    eng._decode_chunk_call = counting
+    it = eng.create_chat_completion(MSGS, stream=True, temperature=0.0,
+                                    max_tokens=100)
+    next(it)
+    next(it)
+    at_close = calls[0]
+    it.close()
+    assert calls[0] == at_close       # nothing dispatched after close
+    out = eng.create_chat_completion(MSGS, temperature=0.0, max_tokens=4)
+    assert out["usage"]["completion_tokens"] >= 1
+
+
+def test_mesh_stream_close_stops_decode_immediately(tmp_path):
+    """MeshEngine streams ride the serial path: same close bound."""
+    from llama_fastapi_k8s_gpu_tpu.engine import MeshEngine
+
+    path = str(tmp_path / "tiny-mesh-close.gguf")
+    write_tiny_llama_gguf(path)
+    eng = MeshEngine(path, dp=2, tp=2, batch_size=2, n_ctx=128,
+                     decode_chunk=4, max_gen_tokens=100,
+                     prefill_buckets=(32, 64, 128))
+    calls = [0]
+    orig = eng._decode_chunk_call
+
+    def counting(*a, **kw):
+        calls[0] += 1
+        return orig(*a, **kw)
+
+    eng._decode_chunk_call = counting
+    it = eng.create_chat_completion(MSGS, stream=True, temperature=0.0,
+                                    max_tokens=100)
+    next(it)
+    next(it)
+    at_close = calls[0]
+    it.close()
+    assert calls[0] == at_close
+    outs = eng.create_chat_completions([MSGS], temperature=0.0, max_tokens=4)
+    assert outs[0]["usage"]["completion_tokens"] >= 1

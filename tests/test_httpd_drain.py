@@ -86,7 +86,7 @@ def test_stop_drains_inflight_request_then_exits():
         pass
 
 
-def _start_server(app, port, drain_seconds=10):
+def _start_server(app, port, drain_seconds=10, read_timeout=None):
     holder: dict = {}
     ready = threading.Event()
 
@@ -96,7 +96,8 @@ def _start_server(app, port, drain_seconds=10):
         r = asyncio.Event()
         task = asyncio.create_task(httpd.serve(
             app, "127.0.0.1", port, ready_event=r,
-            stop_event=holder["stop"], drain_seconds=drain_seconds))
+            stop_event=holder["stop"], drain_seconds=drain_seconds,
+            read_timeout=read_timeout))
         await r.wait()
         ready.set()
         await task
@@ -359,6 +360,118 @@ def test_duplicate_equal_content_lengths_still_served():
         status, _head, body = _read_response(s)
         assert status == 200, (status, body)
         assert json.loads(body)["response"] == "dup ok"
+    finally:
+        s.close()
+        _stop(holder)
+        holder["thread"].join(10)
+
+
+# ---------------------------------------------------------------------------
+# slowloris guard: header/body read deadline (LFKT_READ_TIMEOUT)
+# ---------------------------------------------------------------------------
+
+def test_slow_headers_get_408_and_close():
+    """A client that sends a request line and then dribbles headers must get
+    408 + Connection: close within the read deadline, not hold the socket
+    forever (the classic slowloris hold)."""
+    port = _free_port()
+    holder = _start_server(create_app(engine=FakeEngine(reply="x")), port,
+                           read_timeout=0.5)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(b"POST /response HTTP/1.1\r\nHost: x\r\n")
+        t0 = time.time()
+        status, head, body = _read_response(s)
+        assert status == 408, (status, head)
+        assert b"connection: close" in head.lower()
+        assert b"read timeout" in body
+        assert time.time() - t0 < 5          # fired at the deadline, not later
+        assert s.recv(1) == b""              # server closed the connection
+    finally:
+        s.close()
+        _stop(holder)
+        holder["thread"].join(10)
+
+
+def test_slow_body_gets_408_and_close():
+    """Same guard for a body that never finishes arriving."""
+    port = _free_port()
+    holder = _start_server(create_app(engine=FakeEngine(reply="x")), port,
+                           read_timeout=0.5)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(b"POST /response HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Content-Length: 1000\r\n\r\n" + PAYLOAD[:10])
+        status, head, body = _read_response(s)
+        assert status == 408, (status, head)
+        assert b"connection: close" in head.lower()
+    finally:
+        s.close()
+        _stop(holder)
+        holder["thread"].join(10)
+
+
+def test_fast_request_unaffected_by_read_deadline():
+    """A normally-paced request under a tight read deadline still serves."""
+    port = _free_port()
+    holder = _start_server(create_app(engine=FakeEngine(reply="fast ok")),
+                           port, read_timeout=0.5)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(_raw_request(PAYLOAD))
+        status, _head, body = _read_response(s)
+        assert status == 200, (status, body)
+        assert json.loads(body)["response"] == "fast ok"
+    finally:
+        s.close()
+        _stop(holder)
+        holder["thread"].join(10)
+
+
+def test_slow_request_line_gets_408_and_close():
+    """The request line itself is covered on a fresh connection: a client
+    dribbling a partial request line (no newline) must be answered 408 and
+    closed within the read deadline, not held forever (the pre-guard
+    slowloris variant that never reaches the header parser)."""
+    port = _free_port()
+    holder = _start_server(create_app(engine=FakeEngine(reply="x")), port,
+                           read_timeout=0.5)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(b"POST /resp")            # partial request line, no \n
+        t0 = time.time()
+        status, head, body = _read_response(s)
+        assert status == 408, (status, head)
+        assert b"connection: close" in head.lower()
+        assert time.time() - t0 < 5
+        assert s.recv(1) == b""             # server closed the connection
+    finally:
+        s.close()
+        _stop(holder)
+        holder["thread"].join(10)
+
+
+def test_keepalive_second_request_line_dribble_gets_408():
+    """One cheap valid request must not buy an unguarded dribble slot: a
+    partial SECOND request line on a kept-alive connection is answered 408
+    and closed once its first byte has arrived and the deadline passes —
+    while true idle (zero bytes) keep-alive remains unbounded."""
+    port = _free_port()
+    holder = _start_server(create_app(engine=FakeEngine(reply="ok1")), port,
+                           read_timeout=0.5)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(_raw_request(PAYLOAD))
+        status, head, _body = _read_response(s)
+        assert status == 200 and b"keep-alive" in head.lower()
+        time.sleep(0.8)                 # idle past the deadline: still open
+        s.sendall(b"POST /resp")        # then a dribbled partial line
+        t0 = time.time()
+        status, head, _body = _read_response(s)
+        assert status == 408, (status, head)
+        assert b"connection: close" in head.lower()
+        assert time.time() - t0 < 5
     finally:
         s.close()
         _stop(holder)

@@ -13,6 +13,12 @@ import numpy as np
 import pytest
 
 from llama_fastapi_k8s_gpu_tpu.gguf.constants import GGMLType
+
+# jax-version compat: jax.tree.flatten_with_path landed after 0.4.37; the
+# tree_util spelling exists on every version this repo supports (the same
+# shim family as parallel/ring.py's shard_map fallback)
+_flatten_with_path = getattr(
+    jax.tree, "flatten_with_path", None) or jax.tree_util.tree_flatten_with_path
 from llama_fastapi_k8s_gpu_tpu.gguf.quants import dequantize, quantize
 from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
 from llama_fastapi_k8s_gpu_tpu.models.generate import init_state, prefill_jit
@@ -190,8 +196,8 @@ def test_load_params_on_device_matches_host(tmp_path, fmt):
     gf = GGUFFile(path)
     host = load_params(gf, cfg, fmt=fmt, on_device=False)
     dev = load_params(gf, cfg, fmt=fmt, on_device=True)
-    flat_h, tree_h = jax.tree.flatten_with_path(host)
-    flat_d, tree_d = jax.tree.flatten_with_path(dev)
+    flat_h, tree_h = _flatten_with_path(host)
+    flat_d, tree_d = _flatten_with_path(dev)
     assert tree_h == tree_d
     for (path_h, h), (_, d) in zip(flat_h, flat_d):
         assert h.dtype == d.dtype and h.shape == d.shape
@@ -226,8 +232,8 @@ def test_load_params_overlap_matches_default(tmp_path, fmt, monkeypatch):
     base = load_params(gf, cfg, fmt=fmt, on_device=False)
     monkeypatch.setenv("LFKT_LOAD_OVERLAP", "1")
     over = load_params(gf, cfg, fmt=fmt, on_device=False)
-    flat_b, tree_b = jax.tree.flatten_with_path(base)
-    flat_o, tree_o = jax.tree.flatten_with_path(over)
+    flat_b, tree_b = _flatten_with_path(base)
+    flat_o, tree_o = _flatten_with_path(over)
     assert tree_b == tree_o
     for (p, b), (_, o) in zip(flat_b, flat_o):
         assert b.dtype == o.dtype and b.shape == o.shape, p

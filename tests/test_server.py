@@ -326,3 +326,62 @@ async def test_health_reports_engine_config(tmp_path):
             assert all(v in ("q4k-fused", "q5k-fused", "q6k-fused",
                              "int8", "bf16") for v in eng["weight_formats"].values())
         await app.router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client disconnect mid-stream (resilience layer): the sse generator's
+# finally cancels the request future, which every engine path watches —
+# the serial run() loop per chunk, the continuous scheduler via abandon
+# ---------------------------------------------------------------------------
+
+def test_stream_client_disconnect_reclaims_engine():
+    """A client that drops its socket mid-SSE must free the engine within
+    ~one chunk: a follow-up request is served promptly instead of waiting
+    for the dead stream to drip out its full reply."""
+    import socket
+    import struct
+    import time as _time
+
+    from tests.test_httpd_drain import (
+        PAYLOAD,
+        _free_port,
+        _raw_request,
+        _read_response,
+        _start_server,
+        _stop,
+    )
+
+    # full stream would take ~4 s (400 chunks x 10 ms)
+    eng = FakeEngine(reply="z" * 400, chunk_delay=0.01)
+    port = _free_port()
+    holder = _start_server(create_app(engine=eng), port)
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(_raw_request(PAYLOAD, path=b"/response/stream"))
+        first = s.recv(4096)                     # status line + first chunks
+        assert b"200" in first.split(b"\r\n", 1)[0]
+        # abrupt close with RST so the server's next write fails fast
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+        s.close()
+
+        t0 = _time.time()
+        s2 = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            s2.sendall(_raw_request(PAYLOAD))
+            status, _head, _body = _read_response(s2)
+        finally:
+            s2.close()
+        elapsed = _time.time() - t0
+        assert status == 200
+        # serial consumer: the second request waits behind the stream task;
+        # prompt service proves the abandoned stream stopped early (the
+        # un-reclaimed path would hold it for the remaining ~4 s)
+        assert elapsed < 2.5, f"engine not reclaimed after disconnect: {elapsed:.1f}s"
+    finally:
+        try:
+            s.close()
+        except OSError:
+            pass
+        _stop(holder)
+        holder["thread"].join(10)

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Fault drill: arm one injection point against a live FakeEngine server and
+assert the /health state transitions — the resilience layer's smoke test.
+
+Runs entirely on CPU with no model: a FakeEngine server (in-tree httpd) is
+started on a free localhost port with a fast-tuned watchdog, the
+``decode_step`` injection point is armed for an exception burst, traffic is
+driven until the watchdog trips, and the drill asserts the documented
+lifecycle (docs/RUNBOOK.md "Degraded-mode operations"):
+
+    READY  →  (burst)  →  DEGRADED: readiness 503 + liveness 200
+           →  (bounded recovery)  →  READY, watchdog counters in /metrics
+
+Exit code 0 = drill passed.  Wired into the tier-1 CPU gate via
+tests/test_resilience.py::test_fault_drill_script.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/fault_drill.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BURST = 3
+PAYLOAD = json.dumps({
+    "bot_profile": {"name": "Drill", "appearance": "a,b,c,d",
+                    "system_prompt": "You are terse."},
+    "user_profile": {"name": "Op"},
+    "context": [{"turn": "user", "message": "hi"}],
+}).encode()
+
+
+def _get(port: int, path: str) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _get_text(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def _post(port: int) -> int:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/response", data=PAYLOAD,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+    except Exception:  # noqa: BLE001 — connection-level failure
+        return -1
+
+
+def _wait_for(predicate, timeout: float, what: str):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"drill timed out waiting for: {what}")
+
+
+def main() -> int:
+    from llama_fastapi_k8s_gpu_tpu.engine.fake import FakeEngine
+    from llama_fastapi_k8s_gpu_tpu.server import httpd
+    from llama_fastapi_k8s_gpu_tpu.server.app import create_app
+    from llama_fastapi_k8s_gpu_tpu.utils.config import Settings
+    from llama_fastapi_k8s_gpu_tpu.utils.faults import FAULTS
+
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    engine = FakeEngine(reply="drill ok")
+    settings = Settings(
+        watchdog=True,
+        watchdog_poll_seconds=0.05,
+        watchdog_error_burst=BURST,
+        watchdog_error_window=10.0,
+        # 0.5 s recovery backoff: the DEGRADED window is wide enough for
+        # the drill to observe readiness-503 deterministically
+        watchdog_backoff_seconds=0.5,
+        watchdog_max_recoveries=5,
+        timeout_seconds=5.0,
+    )
+    app = create_app(engine=engine, settings=settings)
+
+    holder: dict = {}
+    ready = threading.Event()
+
+    async def serve():
+        holder["loop"] = asyncio.get_running_loop()
+        holder["stop"] = asyncio.Event()
+        r = asyncio.Event()
+        task = asyncio.create_task(httpd.serve(
+            app, "127.0.0.1", port, ready_event=r,
+            stop_event=holder["stop"], drain_seconds=5))
+        await r.wait()
+        ready.set()
+        await task
+
+    th = threading.Thread(target=lambda: asyncio.run(serve()), daemon=True)
+    th.start()
+    assert ready.wait(10), "server never became ready"
+    observed: list[str] = []
+
+    try:
+        # -- phase 0: healthy baseline --------------------------------------
+        code, body = _get(port, "/health/ready")
+        assert code == 200 and body["state"] == "READY", (code, body)
+        code, _ = _get(port, "/health/live")
+        assert code == 200
+        assert _post(port) == 200
+        observed.append("READY")
+        print(f"[drill] baseline READY on :{port}, request served")
+
+        # -- phase 1: arm the injection point and force an exception burst --
+        FAULTS.arm(f"decode_step:error:times={BURST}")
+        print(f"[drill] armed decode_step:error:times={BURST}")
+        for i in range(BURST):
+            code = _post(port)
+            assert code in (500, 503), f"burst request {i} got {code}"
+        # watchdog (poll 50 ms) must trip; the 0.5 s recovery backoff keeps
+        # the DEGRADED window open long enough to probe it
+        _wait_for(lambda: app.state.watchdog is not None
+                  and app.state.watchdog.trips >= 1, 5, "watchdog trip")
+        code, body = _get(port, "/health/ready")
+        assert code == 503, f"readiness must shed in DEGRADED, got {code}"
+        assert body["state"] == "DEGRADED", body
+        code, _ = _get(port, "/health/live")
+        assert code == 200, "liveness must hold through DEGRADED"
+        observed.append("DEGRADED")
+        print("[drill] watchdog tripped → DEGRADED "
+              "(readiness shed, liveness intact)")
+
+        # -- phase 2: bounded recovery back to READY ------------------------
+        _wait_for(lambda: _get(port, "/health/ready")[0] == 200,
+                  10, "recovery back to READY")
+        observed.append("READY")
+        assert engine.recoveries >= 1, "engine.recover() never ran"
+        assert _post(port) == 200, "post-recovery request failed"
+        metrics = _get_text(port, "/metrics")
+        assert "watchdog_trips_total" in metrics
+        assert "watchdog_recoveries_total" in metrics
+        assert "health_state 1" in metrics      # READY (utils/health.py codes)
+        print("[drill] recovered → READY; watchdog counters in /metrics")
+
+        print(f"[drill] PASS: {' → '.join(observed)} "
+              f"(trips={app.state.watchdog.trips}, "
+              f"recoveries={app.state.watchdog.recoveries})")
+        return 0
+    finally:
+        FAULTS.disarm()
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        th.join(10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
