@@ -69,6 +69,10 @@ class MeshEngine(Engine):
     feeds with up-to-``batch_size`` queued requests at a time.
     """
 
+    # the batched state joins the serial ring under the generation mutex
+    # (lfkt-lint LOCK001; docs/RUNBOOK.md "Lock discipline annotations")
+    _GUARDED_BY = {"_bstate": "_lock"}
+
     def __init__(self, model_path: str | None, *, dp: int | None = None,
                  tp: int = 1, batch_size: int | None = None, **kw):
         super().__init__(model_path, **kw)
@@ -88,7 +92,7 @@ class MeshEngine(Engine):
         self._bstate = jax.device_put(
             state, state_shardings(self.cfg, self.mesh, batched=True))
 
-    def _recover_locked(self) -> None:
+    def _recover_locked(self) -> None:  # lfkt: holds[_lock]
         """Watchdog recovery: a crash mid-cycle may have poisoned the donated
         batched state, so rebuild it (sharded) along with the serial ring."""
         super()._recover_locked()
@@ -106,12 +110,15 @@ class MeshEngine(Engine):
         self.create_chat_completions([msgs] * self.batch_size,
                                      max_tokens=self.decode_chunk + 1,
                                      temperature=0.0)
-        for bucket in self.prefill_buckets[1:]:
-            tokens = jnp.zeros((self.batch_size, bucket), jnp.int32)
-            lengths = jnp.ones((self.batch_size,), jnp.int32)
-            _, caches = batched_prefill_jit(
-                self.params, self.cfg, tokens, lengths, self._bstate["cache"])
-            self._bstate["cache"] = caches
+        with self._lock:   # uncontended at warmup; keeps the _bstate
+            #                write invariant (writes only under _lock)
+            for bucket in self.prefill_buckets[1:]:
+                tokens = jnp.zeros((self.batch_size, bucket), jnp.int32)
+                lengths = jnp.ones((self.batch_size,), jnp.int32)
+                _, caches = batched_prefill_jit(
+                    self.params, self.cfg, tokens, lengths,
+                    self._bstate["cache"])
+                self._bstate["cache"] = caches
         super().warmup()  # serial buckets + decode chunk (streaming path)
         logger.info("mesh warmup done in %.1fs (dp=%d tp=%d batch=%d)",
                     time.time() - t0, self.mesh.shape["dp"],
@@ -180,7 +187,7 @@ class MeshEngine(Engine):
                 and deadlines[b] is not None and now > deadlines[b])
 
     def _generate_batch(self, batch_messages, sp, max_tokens, stops, seed,
-                        deadlines=None, aborts=None):
+                        deadlines=None, aborts=None):  # lfkt: holds[_lock]
         B = self.batch_size
         n_real = len(batch_messages)
         dummy = [self.tokenizer.bos_id or 0]

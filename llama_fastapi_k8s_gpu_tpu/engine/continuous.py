@@ -146,6 +146,30 @@ class ContinuousEngine(MeshEngine):
 
     _SPEC_LANES = True   # serves spec_decode="lookup" via batched verify
 
+    # -- thread discipline (machine-checked: lfkt-lint LOCK001-004, see
+    # docs/RUNBOOK.md "Lock discipline annotations") ----------------------
+    # The scheduler thread OWNS the device state: unlike MeshEngine (whose
+    # callers mutate _bstate under _lock), every serving-path write to the
+    # state below happens on the lfkt-scheduler thread, so the parent's
+    # lock mapping is replaced by thread confinement.  The only
+    # cross-thread writes are in recover(), which runs strictly after the
+    # thread is proven dead (join + alive/_loop_error guards).
+    _GUARDED_BY = {
+        "_bstate": None,            # scheduler-confined here (see above)
+        "_cache": None,             # serial ring unused on the submit path
+        "_prefix_ids": None,
+        "_req_counter": "_id_lock",
+    }
+    _THREAD_ENTRIES = ("_loop",)
+    _THREAD_CONFINED = (
+        "_bstate", "_lane_st", "_scratch_cache", "_adm", "_lane_claims",
+        "_prefix_stats", "_spec_stats", "_stats", "_loop_error",
+    )
+    # cross-thread by design; individual operations are GIL-atomic
+    # (dict/Queue/Event ops) or single reference stores
+    _SHARED_ATOMIC = ("_items", "_pending", "_wake", "_stop", "_shutdown",
+                      "_thread")
+
     def __init__(self, model_path: str | None, *, max_top_k: int = 64,
                  prefill_chunk: int = 256, adm_budget: int = 512,
                  lane_prefix_cache: bool = False, **kw):
@@ -337,7 +361,7 @@ class ContinuousEngine(MeshEngine):
             elif item.sink is not None:
                 item.sink.put(exc)
 
-    def recover(self) -> bool:
+    def recover(self) -> bool:  # lfkt: noqa[LOCK002] -- writes scheduler-confined state only after the owning thread is proven dead (join + alive/_loop_error refusal guards above each write)
         """Bounded recovery (engine/watchdog.py): restart a *dead* scheduler
         on rebuilt device state.  Refuses while the loop thread is alive and
         unfailed — a wedged thread may still own the donated buffers, and

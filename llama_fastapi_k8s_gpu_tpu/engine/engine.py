@@ -117,6 +117,18 @@ class _TextEmitter:
 class Engine:
     """Loads a GGUF model and serves chat completions on the local device(s)."""
 
+    # -- lock discipline (machine-checked: lfkt-lint LOCK001-004, see
+    # docs/RUNBOOK.md "Lock discipline annotations") ----------------------
+    # _lock is the single-generator mutex: the KV ring and its prefix
+    # claim may only change under it.  _id_lock is the tiny counter lock
+    # shared with scheduler threads (seed sequence, last-timings swap).
+    _GUARDED_BY = {
+        "_cache": "_lock",
+        "_prefix_ids": "_lock",
+        "_requests": "_id_lock",
+        "last_timings": "_id_lock",
+    }
+
     def __init__(
         self,
         model_path: str | None,
@@ -418,26 +430,29 @@ class Engine:
             self.create_chat_completion(
                 [{"role": "user", "content": "alpha bravo charlie delta"}],
                 max_tokens=self.decode_chunk + 1, temperature=0.0)
-        for b in self.prefill_buckets[1:]:
-            ids = [0] * (b - 1)
-            cache = self._cache
-            logits, cache = self._prefill_call(
-                jnp.asarray(ids + [0], jnp.int32)[:b], jnp.int32(len(ids)), cache)
-            jax.block_until_ready(logits)
-            self._cache = cache
-        if self._prefix_cache:
-            # compile the suffix pass for every bucket a reuse suffix can
-            # land in (all but the largest — _prefix_reuse_len only grants
-            # reuse when the suffix bucket is strictly smaller than the
-            # prompt's), preserving the no-cold-compile-after-warmup
-            # invariant on the reuse path too.  Also drops the claim over
-            # the garbage the raw bucket loop above wrote into the ring.
-            for b in self.prefill_buckets[:-1]:
-                logits, self._cache = prefill_chunk_jit(
-                    self.params, self.cfg, jnp.zeros((b,), jnp.int32),
-                    jnp.int32(0), jnp.int32(b - 1), self._cache)
+        with self._lock:   # uncontended at warmup; the ring-write invariant
+            #                (writes to _cache only under _lock) stays intact
+            for b in self.prefill_buckets[1:]:
+                ids = [0] * (b - 1)
+                cache = self._cache
+                logits, cache = self._prefill_call(
+                    jnp.asarray(ids + [0], jnp.int32)[:b], jnp.int32(len(ids)),
+                    cache)
                 jax.block_until_ready(logits)
-            self._prefix_ids = []
+                self._cache = cache
+            if self._prefix_cache:
+                # compile the suffix pass for every bucket a reuse suffix can
+                # land in (all but the largest — _prefix_reuse_len only grants
+                # reuse when the suffix bucket is strictly smaller than the
+                # prompt's), preserving the no-cold-compile-after-warmup
+                # invariant on the reuse path too.  Also drops the claim over
+                # the garbage the raw bucket loop above wrote into the ring.
+                for b in self.prefill_buckets[:-1]:
+                    logits, self._cache = prefill_chunk_jit(
+                        self.params, self.cfg, jnp.zeros((b,), jnp.int32),
+                        jnp.int32(0), jnp.int32(b - 1), self._cache)
+                    jax.block_until_ready(logits)
+                self._prefix_ids = []
         logger.info("warmup done in %.1fs (%d prefill buckets)",
                     time.time() - t0, len(self.prefill_buckets))
 
@@ -488,7 +503,7 @@ class Engine:
         finally:
             self._lock.release()
 
-    def _recover_locked(self) -> None:
+    def _recover_locked(self) -> None:  # lfkt: holds[_lock]
         """Engine-specific state re-init, called with the lock held."""
         self._cache = init_cache(self.cfg)
         self._prefix_ids = []
@@ -560,7 +575,7 @@ class Engine:
                               deadline=deadline, abort=abort)
 
     # ------------------------------------------------------------------
-    def _start(self, messages, sp: SamplingParams, seed):
+    def _start(self, messages, sp: SamplingParams, seed):  # lfkt: holds[_lock]
         """Shared prefill + first-token path. Returns a mutable gen context."""
         t0 = time.time()
         self.heartbeat.beat()
@@ -651,7 +666,7 @@ class Engine:
                 return r
         return 0
 
-    def _finish(self, ctx) -> dict:
+    def _finish(self, ctx) -> dict:  # lfkt: holds[_lock]
         """Return the cache buffer for reuse; finalize per-phase timings.
         Returns the timings dict (also published to :attr:`last_timings`)."""
         self._cache = ctx["state"]["cache"]
@@ -942,7 +957,7 @@ class Engine:
                 self.heartbeat.leave()
 
     def _generate_locked(self, messages, sp, max_tokens, stops, seed,
-                         deadline, abort) -> dict:
+                         deadline, abort) -> dict:  # lfkt: holds[_lock]
         t0 = time.time()
         ctx = self._start(messages, sp, seed)
         ctx["deadline"] = deadline

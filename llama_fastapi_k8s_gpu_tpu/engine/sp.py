@@ -61,7 +61,7 @@ class SPEngine(Engine):
         logger.info("SPEngine: n_ctx=%d over sp=%d tp=%d (%d devices)",
                     self.cfg.n_ctx, sp, tp, sp * tp)
 
-    def _recover_locked(self) -> None:
+    def _recover_locked(self) -> None:  # lfkt: holds[_lock]
         """Watchdog recovery: the fresh ring must carry the same sp-sharded
         layout __init__ installed — the base class's unsharded init_cache
         would replicate the full n_ctx ring per device, defeating the

@@ -27,10 +27,12 @@ all its inputs are logged and exposed as ``engine.spec_auto_decision``.
 
 from __future__ import annotations
 
-import os
 import time
 
+from ..utils.config import knob
+
 HBM_GBPS_DEFAULT = 819.0   # v5e spec; override via LFKT_HBM_GBPS
+#                            (registry default mirrors this constant)
 
 
 def measure_dispatch_rtt_s(n: int = 7) -> float:
@@ -74,9 +76,9 @@ def resolve_auto(params, *, hbm_gbps: float | None = None,
     failure resolves to "off" with the error recorded (degradation
     contract, docs/PERF.md)."""
     if hbm_gbps is None:
-        hbm_gbps = float(os.environ.get("LFKT_HBM_GBPS", HBM_GBPS_DEFAULT))
+        hbm_gbps = knob("LFKT_HBM_GBPS", default=HBM_GBPS_DEFAULT)
     if accept is None:
-        accept = float(os.environ.get("LFKT_SPEC_AUTO_ACCEPT", "1.0"))
+        accept = knob("LFKT_SPEC_AUTO_ACCEPT")
     try:
         # module-global lookup so tests can monkeypatch the measurement
         rtt_s = measure_dispatch_rtt_s()

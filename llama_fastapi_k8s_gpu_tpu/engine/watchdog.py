@@ -42,6 +42,16 @@ logger = logging.getLogger(__name__)
 class Watchdog:
     """Samples an engine heartbeat; degrades, recovers, or escalates."""
 
+    # -- thread discipline (lfkt-lint LOCK002; docs/RUNBOOK.md) -----------
+    # the trip/recovery bookkeeping is watchdog-thread-confined: check()/
+    # handle_trip() are public for tests and the drill, but a live serving
+    # process drives them only from _loop.  _stop is a threading.Event
+    # (atomic by design) shared with stop().
+    _THREAD_ENTRIES = ("_loop",)
+    _THREAD_CONFINED = ("trips", "recoveries", "trips_window",
+                        "_last_trip_at", "last_trip_reason")
+    _SHARED_ATOMIC = ("_stop",)
+
     def __init__(self, engine, health, metrics=None, *,
                  stall_seconds: float = 30.0,
                  poll_seconds: float = 1.0,

@@ -1,0 +1,38 @@
+"""lfkt-lint — in-tree static analysis enforcing this repo's invariants.
+
+The codebase grew from a 597-line reference into ~13k LoC of
+concurrency-heavy serving code whose correctness rests on hand-maintained
+protocols: lock disciplines in the engines (engine/engine.py,
+engine/continuous.py), purity of everything reachable from a ``jax.jit``
+trace (a stray host sync or env read inside the traced graph is the
+synchronization-boundary tax Kernel Looping, arXiv:2410.23668, identifies
+as the dominant decode overhead), a single env-knob registry
+(utils/config.py) that Helm and the docs must agree with, and the
+probe/fallback contract every Pallas kernel follows (ops/pallas/probe.py).
+None of those invariants were machine-checked; PR 2 found lock/heartbeat
+bugs only via fault drills, after the fact.
+
+This package checks them at test time, on CPU, stdlib-``ast`` only:
+
+- :mod:`.locks`     — LOCK001-004: ``_GUARDED_BY`` lock discipline and
+                      thread-confinement declarations on engine classes.
+- :mod:`.jit`       — JIT001-003: impure calls, closed-over-state mutation
+                      and host syncs inside jit-reachable functions.
+- :mod:`.configreg` — CFG001-005: every LFKT_* env read routes through the
+                      utils/config.py registry; registry ↔ docs ↔ Helm
+                      three-way cross-check; probe routes exist.
+- :mod:`.kernels`   — KER001-003: Pallas kernels carry an interpret gate,
+                      a probe or XLA fallback, and static block shapes.
+- :mod:`.deadcode`  — DEAD001-002: unreferenced module-level functions and
+                      bogus ``__all__`` entries.
+
+Run ``python -m llama_fastapi_k8s_gpu_tpu.lint`` (exit 1 on findings,
+``--json`` for machine-readable output), ``tools/lint_report.py`` for a
+per-rule table, or the tier-1 tests in tests/test_lint.py.  Suppress a
+finding with ``# lfkt: noqa[<RULE>] -- reason`` (the reason is mandatory;
+unknown rule IDs are themselves findings).  Rule catalog: docs/LINT.md.
+"""
+
+from .core import Finding, all_rules, run_lint  # noqa: F401
+
+__all__ = ["Finding", "all_rules", "run_lint"]

@@ -1,0 +1,58 @@
+"""``python -m llama_fastapi_k8s_gpu_tpu.lint`` — run the lint suite.
+
+Exit status 0 when the tree has zero unsuppressed findings, 1 otherwise
+(machine-consumable: CI gates on it).  stdlib-only, no jax import, runs
+in a couple of seconds on CPU.
+
+Options:
+  --json           one JSON object per finding on stdout (machine-readable)
+  --all            include suppressed findings in the output
+  --rule R [...]   restrict to specific rule IDs
+  --package DIR    analyze a different package tree (fixture self-tests)
+  --root DIR       repo root for helm/docs cross-checks
+  --list-rules     print the rule catalog and exit
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import all_rules, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="llama_fastapi_k8s_gpu_tpu.lint")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--all", action="store_true",
+                    help="include suppressed findings")
+    ap.add_argument("--rule", nargs="*", default=None)
+    ap.add_argument("--package", default=None)
+    ap.add_argument("--root", default=None)
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(all_rules().items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    findings = run_lint(package_dir=args.package, repo_root=args.root,
+                        rules=args.rule)
+    live = [f for f in findings if not f.suppressed]
+    shown = findings if args.all else live
+    if args.json:
+        for f in shown:
+            print(json.dumps(vars(f)))
+    else:
+        for f in shown:
+            print(f.render())
+        n_sup = sum(1 for f in findings if f.suppressed)
+        print(f"lfkt-lint: {len(live)} finding(s), {n_sup} suppressed",
+              file=sys.stderr)
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

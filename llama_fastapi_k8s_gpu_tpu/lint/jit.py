@@ -1,0 +1,349 @@
+"""JIT001-003: purity of everything reachable from a jit trace.
+
+A Python side effect inside a traced function does not run per step — it
+runs once at trace time and silently bakes its value into the compiled
+program (env reads, ``time.*``), retraces on closed-over-state mutation,
+or forces a host⇄device synchronization (``.item()``,
+``block_until_ready``) in the middle of the decode hot loop — the exact
+synchronization-boundary overhead Kernel Looping (arXiv:2410.23668)
+identifies as dominating decode.  None of these fail loudly; all of them
+are invisible in tests that only check outputs.
+
+The checker builds a call graph from the module ASTs:
+
+- roots: functions decorated with ``jax.jit``/``pjit`` (directly or via
+  ``functools.partial``), and functions passed to ``jax.jit(...)`` /
+  ``shard_map(...)`` call expressions;
+- edges: calls by simple name (nearest lexical scope, then module level),
+  ``self.method()`` (same class), names imported from package modules
+  (``from ..x import y`` / ``from .. import x; x.f()``); functions passed
+  as call *arguments* inside reachable code (``lax.scan(body, ...)``,
+  ``pl.pallas_call(kernel, ...)``) are reachable too, as is everything
+  lexically nested in a reachable function.  Resolution is by name and
+  deliberately over-approximates — a false edge costs a suppression with
+  a written reason, a missing edge costs silence.
+
+Within the reachable set it flags:
+
+- JIT001 — impure calls: ``time.*``, ``os.environ`` / ``os.getenv``,
+  ``np.random.*`` / ``random.*``, ``print``.  Trace-time-only reads that
+  are deliberately baked into the program (and keyed into the jit cache)
+  carry a def-line ``# lfkt: noqa[JIT001] -- reason``.
+- JIT002 — mutation of closed-over Python state: ``global`` / ``nonlocal``
+  declarations inside a traced function.
+- JIT003 — host syncs: ``.item()``, ``jax.block_until_ready``,
+  ``jax.device_get``, ``np.asarray``/``np.array``.  (``float()``/``int()``
+  casts are NOT flagged: on static Python scalars they are legitimate and
+  common, and the AST cannot see tracedness — the runtime's
+  ConcretizationTypeError stays the guard there.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, Source, dotted
+
+RULES = {
+    "JIT001": "impure call (time/os.environ/np.random/print) inside "
+              "jit-reachable code",
+    "JIT002": "closed-over Python state mutated (global/nonlocal) inside "
+              "jit-reachable code",
+    "JIT003": "host synchronization (.item()/block_until_ready/device_get/"
+              "np.asarray) inside jit-reachable code",
+}
+
+_JIT_NAMES = {"jit", "pjit", "shard_map"}
+
+
+class _Fn:
+    __slots__ = ("key", "src", "node", "module", "cls", "nested_in")
+
+    def __init__(self, key, src, node, module, cls, nested_in):
+        self.key = key              # (module, qualname)
+        self.src = src
+        self.node = node
+        self.module = module
+        self.cls = cls              # enclosing class name or None
+        self.nested_in = nested_in  # enclosing function key or None
+
+
+class _Index:
+    """All functions + package-internal import aliases, per module."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.fns: dict[tuple, _Fn] = {}
+        #: module -> simple name -> [keys]
+        self.by_name: dict[str, dict[str, list[tuple]]] = {}
+        #: module -> class -> method name -> key
+        self.methods: dict[str, dict[str, dict[str, tuple]]] = {}
+        #: module -> alias -> [("mod", module) | ("name", module, name)]
+        #: (multi-valued: the same local alias may bind different targets
+        #: in different function scopes — ``from .pallas import X as m``)
+        self.imports: dict[str, dict[str, list[tuple]]] = {}
+        #: children keyed by enclosing function
+        self.nested: dict[tuple, list[tuple]] = {}
+        self.modules = {ctx.module_name(s) for s in ctx.sources}
+        for src in ctx.sources:
+            self._scan(src)
+
+    def _resolve_from(self, node: ast.ImportFrom, module: str,
+                      is_pkg: bool) -> str | None:
+        """Package-relative dotted path of an import's source module,
+        '' for the package root, None for out-of-package imports."""
+        if node.level == 0:
+            pkg = self.ctx.package_name
+            m = node.module or ""
+            if m == pkg:
+                return ""
+            if m.startswith(pkg + "."):
+                return m[len(pkg) + 1:]
+            return None
+        parts = [p for p in module.split(".") if p]
+        pkg_parts = parts if is_pkg else parts[:-1]
+        up = node.level - 1
+        if up > len(pkg_parts):
+            return None
+        base = pkg_parts[: len(pkg_parts) - up]
+        tail = [p for p in (node.module or "").split(".") if p]
+        return ".".join(base + tail)
+
+    def _scan(self, src: Source):
+        module = self.ctx.module_name(src)
+        is_pkg = src.rel.endswith("__init__.py")
+        names = self.by_name.setdefault(module, {})
+        methods = self.methods.setdefault(module, {})
+        imports = self.imports.setdefault(module, {})
+
+        def walk(node, cls, nested_in):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if nested_in:
+                        qual = f"{nested_in[1]}.<locals>.{child.name}"
+                    elif cls:
+                        qual = f"{cls}.{child.name}"
+                    else:
+                        qual = child.name
+                    key = (module, qual)
+                    fn = _Fn(key, src, child, module, cls, nested_in)
+                    self.fns[key] = fn
+                    names.setdefault(child.name, []).append(key)
+                    if nested_in:
+                        self.nested.setdefault(nested_in, []).append(key)
+                    if cls and not nested_in:
+                        methods.setdefault(cls, {})[child.name] = key
+                    walk(child, None, key)
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, child.name, nested_in)
+                else:
+                    if isinstance(child, ast.ImportFrom):
+                        target = self._resolve_from(child, module, is_pkg)
+                        if target is not None:
+                            for alias in child.names:
+                                local = alias.asname or alias.name
+                                sub = ".".join(
+                                    p for p in (target, alias.name) if p)
+                                if sub in self.modules:
+                                    imports.setdefault(local, []).append(
+                                        ("mod", sub))
+                                elif target in self.modules:
+                                    imports.setdefault(local, []).append(
+                                        ("name", target, alias.name))
+                    walk(child, cls, nested_in)
+
+        walk(src.tree, None, None)
+
+    def resolve(self, module: str, node: ast.AST,
+                scope: "_Fn | None") -> list[tuple]:
+        """Function keys a Name/Attribute expression may refer to."""
+        names = self.by_name.get(module, {})
+        imports = self.imports.get(module, {})
+        if isinstance(node, ast.Name):
+            cands = names.get(node.id, [])
+            if scope is not None:
+                local = [k for k in cands
+                         if self.fns[k].nested_in == scope.key]
+                if local:
+                    return local
+                if scope.cls:
+                    m = self.methods.get(module, {}).get(scope.cls, {})
+                    if node.id in m:
+                        return [m[node.id]]
+            if cands:
+                return cands
+            out = []
+            for imp in imports.get(node.id, []):
+                if imp[0] == "name":
+                    out.extend(self.by_name.get(imp[1], {}).get(imp[2], []))
+            return out
+        elif isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            base, attr = node.value.id, node.attr
+            if base == "self" and scope is not None and scope.cls is not None:
+                k = self.methods.get(module, {}).get(scope.cls, {}).get(attr)
+                if k is not None:
+                    return [k]
+            out = []
+            for imp in imports.get(base, []):
+                if imp[0] == "mod":
+                    out.extend(self.by_name.get(imp[1], {}).get(attr, []))
+            return out
+        return []
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    d = dotted(dec)
+    if d and d.split(".")[-1] in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        # @functools.partial(jax.jit, ...) or @jax.jit(...)-style factories
+        f = dotted(dec.func)
+        if f and f.split(".")[-1] in _JIT_NAMES:
+            return True
+        if f and f.split(".")[-1] == "partial":
+            for a in dec.args:
+                ad = dotted(a)
+                if ad and ad.split(".")[-1] in _JIT_NAMES:
+                    return True
+    return False
+
+
+def _roots(index: _Index) -> set[tuple]:
+    roots: set[tuple] = set()
+    for key, fn in index.fns.items():
+        if any(_decorator_is_jit(d) for d in fn.node.decorator_list):
+            roots.add(key)
+    # jax.jit(f) / shard_map(f, ...) with f a resolvable function name;
+    # also functools.partial(jax.jit, ...)(f)-free assignment forms
+    for src in index.ctx.sources:
+        module = index.ctx.module_name(src)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = dotted(node.func)
+            if not (f and f.split(".")[-1] in _JIT_NAMES):
+                continue
+            for a in node.args[:1]:
+                roots.update(index.resolve(module, a, scope=None))
+    return roots
+
+
+def _reachable(index: _Index, roots: set[tuple]) -> set[tuple]:
+    seen: set[tuple] = set()
+    todo = list(roots)
+    while todo:
+        key = todo.pop()
+        if key in seen or key not in index.fns:
+            continue
+        seen.add(key)
+        fn = index.fns[key]
+        # everything lexically nested in a traced function runs under trace
+        todo.extend(index.nested.get(key, []))
+        for node in ast.walk(fn.node):
+            # follow any resolvable function REFERENCE, not just direct
+            # calls: dispatch tables return/store function objects
+            # (ops/linear._fused_fns) and higher-order wrappers take them
+            # as arguments (lax.scan bodies, pallas_call kernels)
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                todo.extend(index.resolve(fn.module, node, scope=fn))
+    return seen
+
+
+def _scan_body(fn: _Fn, ctx: Context) -> list[Finding]:
+    out = []
+    path = ctx.display_path(fn.src)
+    qual = fn.key[1]
+
+    for node in ast.walk(fn.node):
+        # nested defs are separate reachable nodes; don't double-report.
+        # (ast.walk can't skip subtrees, so filter by ownership instead)
+        if _owner(fn.node, node) is not fn.node:
+            continue
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            out.append(Finding(
+                "JIT002", path, node.lineno,
+                f"{qual} mutates closed-over state "
+                f"({'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                f" {', '.join(node.names)}) in jit-reachable code"))
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d:
+                parts = d.split(".")
+                head, tail = parts[0], parts[-1]
+                if head == "time" and len(parts) > 1:
+                    out.append(Finding(
+                        "JIT001", path, node.lineno,
+                        f"{qual} calls {d}() in jit-reachable code "
+                        "(trace-time constant, not a per-step clock)"))
+                elif d in ("os.getenv", "os.environ.get"):
+                    out.append(Finding(
+                        "JIT001", path, node.lineno,
+                        f"{qual} reads the environment in jit-reachable "
+                        "code (baked in at trace time)"))
+                elif (head in ("np", "numpy") and len(parts) > 2
+                      and parts[1] == "random") or head == "random" \
+                        and len(parts) > 1:
+                    out.append(Finding(
+                        "JIT001", path, node.lineno,
+                        f"{qual} calls {d}() in jit-reachable code "
+                        "(host RNG freezes at trace; use jax.random)"))
+                elif d == "print":
+                    out.append(Finding(
+                        "JIT001", path, node.lineno,
+                        f"{qual} calls print() in jit-reachable code "
+                        "(runs once at trace; use jax.debug.print)"))
+                elif tail in ("block_until_ready", "device_get") \
+                        and len(parts) > 1:
+                    out.append(Finding(
+                        "JIT003", path, node.lineno,
+                        f"{qual} calls {tail}() in jit-reachable code "
+                        "(host sync in the traced graph)"))
+                elif head in ("np", "numpy") and len(parts) == 2 \
+                        and tail in ("asarray", "array"):
+                    out.append(Finding(
+                        "JIT003", path, node.lineno,
+                        f"{qual} calls {d}() in jit-reachable code "
+                        "(device→host materialization)"))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                out.append(Finding(
+                    "JIT003", path, node.lineno,
+                    f"{qual} calls .item() in jit-reachable code "
+                    "(host sync in the traced graph)"))
+        if isinstance(node, ast.Subscript) \
+                and dotted(node.value) == "os.environ":
+            out.append(Finding(
+                "JIT001", path, node.lineno,
+                f"{qual} reads os.environ in jit-reachable code "
+                "(baked in at trace time)"))
+    return out
+
+
+def _owner(root: ast.AST, node: ast.AST) -> ast.AST:
+    """The innermost function def (or root) lexically containing node —
+    computed via a cached parent map on the root."""
+    cache = getattr(root, "_lfkt_owner", None)
+    if cache is None:
+        cache = {}
+
+        def assign(n, owner):
+            for child in ast.iter_child_nodes(n):
+                cache[id(child)] = owner
+                assign(child, child if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    else owner)
+
+        assign(root, root)
+        root._lfkt_owner = cache
+    return cache.get(id(node), root)
+
+
+def check(ctx: Context) -> list[Finding]:
+    index = _Index(ctx)
+    reachable = _reachable(index, _roots(index))
+    out: list[Finding] = []
+    for key in sorted(reachable):
+        fn = index.fns.get(key)
+        if fn is not None:
+            out.extend(_scan_body(fn, ctx))
+    return out

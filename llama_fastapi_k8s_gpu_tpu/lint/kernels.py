@@ -1,0 +1,109 @@
+"""KER001-003: the Pallas kernel contract (ops/pallas/probe.py pattern).
+
+A Mosaic lowering failure on a new libtpu must degrade a pod to a slower
+path, never crash-loop it; and CPU tests must be able to execute every
+kernel in interpret mode.  The contract every kernel module in
+``ops/pallas/`` follows (probe.py + kvquant.py are the reference
+instances):
+
+- KER001 — every ``pl.pallas_call(...)`` call site threads an explicit
+  ``interpret=`` argument (the interpret-mode gate: ``use_interpret()``
+  decides by backend, tests can force it).  A pallas_call without it
+  compiles Mosaic unconditionally — including on the CPU tier-1 gate.
+- KER002 — every module that invokes ``pallas_call`` is covered by a
+  startup compile probe (referenced from ``ops/pallas/probe.py``) or
+  defines a degrade path in-module (an ``*xla*``- or ``*fallback*``-named
+  function), so the *caller* can pick the fallback with correct
+  attribution.
+- KER003 — block shapes stay static: a ``pl.BlockSpec`` shape element
+  must be a constant / name / arithmetic thereof — a function call inside
+  a block shape is how dynamic (traced) extents sneak into the grid,
+  which Mosaic rejects with an unattributable error at first serving
+  request rather than at probe time.
+
+Only modules under ``ops/pallas/`` are checked (the contract is about
+kernel authorship, not kernel use).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, dotted
+
+RULES = {
+    "KER001": "pl.pallas_call without an explicit interpret= gate",
+    "KER002": "pallas kernel module with no startup probe and no XLA "
+              "fallback",
+    "KER003": "pl.BlockSpec block shape contains a call (dynamic extent)",
+}
+
+_DIR = "ops/pallas/"
+
+
+def _shape_has_call(node: ast.AST) -> ast.Call | None:
+    # shape elements may be names/constants/arithmetic/attribute chains
+    # (``TK // 2``, ``x.shape[0]`` — all static at trace time); a Call is
+    # the one form that can smuggle in a dynamic extent
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            return sub
+    return None
+
+
+def check(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    kernel_srcs = [s for s in ctx.sources
+                   if s.rel.startswith(_DIR) and
+                   not s.rel.endswith(("__init__.py", "probe.py"))]
+    probe_src = next((s for s in ctx.sources
+                      if s.rel == _DIR + "probe.py"), None)
+    # modules probe.py actually imports from (AST, not text — a prose
+    # mention in a comment must not count as probe coverage)
+    probed_mods: set[str] = set()
+    if probe_src is not None:
+        for node in ast.walk(probe_src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                probed_mods.add(node.module.split(".")[-1])
+
+    for src in kernel_srcs:
+        path = ctx.display_path(src)
+        uses_pallas_call = False
+        has_xla_fallback = False
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if "xla" in node.name.lower() \
+                        or "fallback" in node.name.lower():
+                    has_xla_fallback = True
+            if not isinstance(node, ast.Call):
+                continue
+            f = dotted(node.func)
+            tail = f.split(".")[-1] if f else None
+            if tail == "pallas_call":
+                uses_pallas_call = True
+                kw = {k.arg for k in node.keywords}
+                if "interpret" not in kw:
+                    out.append(Finding(
+                        "KER001", path, node.lineno,
+                        "pl.pallas_call without interpret=: thread the "
+                        "use_interpret() gate so CPU/tests never compile "
+                        "Mosaic (ops/pallas/probe.py pattern)"))
+            elif tail == "BlockSpec" and node.args:
+                shape = node.args[0]
+                call = _shape_has_call(shape)
+                if call is not None:
+                    out.append(Finding(
+                        "KER003", path, call.lineno,
+                        "pl.BlockSpec block shape contains a call — block "
+                        "shapes must be static (constants, params, or "
+                        "arithmetic thereof)"))
+        if uses_pallas_call:
+            mod = src.rel[len(_DIR):-3]            # e.g. 'qmatmul'
+            if not (mod in probed_mods or has_xla_fallback):
+                out.append(Finding(
+                    "KER002", path, 1,
+                    f"kernel module {mod}.py calls pallas_call but has no "
+                    "compile probe in ops/pallas/probe.py and no in-module "
+                    "XLA fallback — a Mosaic failure will crash-loop the "
+                    "pod instead of degrading"))
+    return out

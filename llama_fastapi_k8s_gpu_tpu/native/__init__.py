@@ -41,7 +41,9 @@ _load_attempted = False
 
 
 def _enabled() -> bool:
-    return os.environ.get("LFKT_NATIVE", "1").strip().lower() not in ("0", "false", "no", "off")
+    from ..utils.config import env_bool
+
+    return env_bool("LFKT_NATIVE", default=True)
 
 
 def _cache_dirs() -> list[str]:

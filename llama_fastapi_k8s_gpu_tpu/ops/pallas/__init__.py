@@ -19,19 +19,12 @@ from __future__ import annotations
 
 import jax
 
-_FORCE_INTERPRET: bool | None = None
-
-
-def force_interpret(value: bool | None) -> None:
-    """Override interpret-mode detection (None = auto by backend)."""
-    global _FORCE_INTERPRET
-    _FORCE_INTERPRET = value
-
 
 def use_interpret() -> bool:
-    """Pallas kernels compile natively only on TPU; interpret elsewhere."""
-    if _FORCE_INTERPRET is not None:
-        return _FORCE_INTERPRET
+    """Pallas kernels compile natively only on TPU; interpret elsewhere.
+    (Callers that need to force a mode pass ``interpret=`` explicitly —
+    every kernel entry point takes it; the old module-global override
+    hook was never used and was removed by the dead-code lint.)"""
     return jax.default_backend() != "tpu"
 
 
@@ -67,6 +60,5 @@ __all__ = [
     "q6k_matmul_stacked",
     "q8_matmul",
     "q8_matmul_stacked",
-    "force_interpret",
     "use_interpret",
 ]

@@ -316,12 +316,40 @@ _DEVICE_DEQUANT = {
     GGMLType.Q6_K: dequant_q6_k_device,
 }
 
+#: latched True after the first Mosaic failure so a broken lowering pays
+#: ONE failed compile, not one per tensor.  Same probe-and-degrade
+#: contract as ops/pallas/probe.py (lfkt-lint KER002), applied lazily
+#: because these kernels only ever run during the weight load — a startup
+#: probe would just duplicate the first tensor's compile.
+_FORCE_HOST = False
+
+
+def _host_fallback(buf: np.ndarray, ggml_type: GGMLType, n: int,
+                   dtype) -> jax.Array:
+    """Numpy codec + plain upload: the degrade path when a device kernel
+    is unavailable (format without a kernel) or failed to lower."""
+    return jnp.asarray(np_dequantize(buf, ggml_type, n), dtype)
+
 
 def device_dequant(buf: np.ndarray, ggml_type: GGMLType, n: int,
                    dtype=jnp.float32, interpret: bool | None = None) -> jax.Array:
     """Flat raw bytes → (n,) device array; falls back to the numpy codec
-    (+ upload) for formats without a device kernel (F16/F32/BF16/Q4_0)."""
+    (+ upload) for formats without a device kernel (F16/F32/BF16/Q4_0) and
+    for ALL tensors once a device kernel fails to lower (new libtpu /
+    unexpected geometry): the load completes slower instead of crash-
+    looping the pod."""
+    global _FORCE_HOST
     fn = _DEVICE_DEQUANT.get(GGMLType(ggml_type))
-    if fn is None:
-        return jnp.asarray(np_dequantize(buf, ggml_type, n), dtype)
-    return fn(np.asarray(buf, dtype=np.uint8).reshape(-1), n, dtype, interpret)
+    if fn is None or _FORCE_HOST:
+        return _host_fallback(buf, ggml_type, n, dtype)
+    try:
+        return fn(np.asarray(buf, dtype=np.uint8).reshape(-1), n, dtype,
+                  interpret)
+    except Exception as e:  # noqa: BLE001 — any failure means "degrade"
+        _FORCE_HOST = True
+        import logging
+
+        logging.getLogger(__name__).error(
+            "device dequant kernel failed for %s; loading via the numpy "
+            "codec from here on: %s", GGMLType(ggml_type).name, e)
+        return _host_fallback(buf, ggml_type, n, dtype)

@@ -200,10 +200,12 @@ def _env_variant(name: str, allowed: tuple) -> str:
     must never silently compare the default against itself).  The value is
     threaded into every jit/lru cache key, so changing the env between
     calls re-traces instead of silently reusing the old program.  Shared
-    by every fused kernel's LFKT_Q*_KERNEL knob."""
-    import os
+    by every fused kernel's LFKT_Q*_KERNEL knob; the read routes through
+    the utils/config.py registry (lfkt-lint CFG001) with each variant
+    table's first entry as the default."""
+    from ...utils.config import knob
 
-    v = os.environ.get(name, allowed[0]).strip().lower()
+    v = knob(name, default=allowed[0]).strip().lower()
     if v not in allowed:
         raise ValueError(f"{name} must be {'|'.join(allowed)}, got {v!r}")
     return v

@@ -4,6 +4,5 @@ from .mesh import (  # noqa: F401
     cache_shardings,
     state_shardings,
     shard_params,
-    shard_cache,
 )
 from .batched import batched_prefill_jit, batched_generate_chunk_jit, init_batched_state  # noqa: F401

@@ -237,6 +237,3 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
     return jax.device_put(
         params, fit_shardings(params, param_shardings(params, mesh)))
 
-
-def shard_cache(cache: dict, cfg: ModelConfig, mesh: Mesh, batched: bool = False) -> dict:
-    return jax.device_put(cache, cache_shardings(cfg, mesh, batched))

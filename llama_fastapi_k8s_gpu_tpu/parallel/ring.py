@@ -235,21 +235,6 @@ def sp_state_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
     return {"k": s4, "v": s4}
 
 
-def sp_gen_state_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
-    """Shardings for the full generation-state pytree
-    (models.generate.init_state) under sequence parallelism: the KV cache
-    seq-sharded over ``sp``, everything else replicated."""
-    rep = NamedSharding(mesh, P())
-    vec = NamedSharding(mesh, P(None))
-    return {
-        "cache": sp_state_shardings(cfg, mesh),
-        "pos": rep,
-        "token": rep,
-        "window": vec,
-        "wpos": rep,
-        "key": vec,
-    }
-
 
 @functools.lru_cache(maxsize=32)
 def _sp_prefill_fn(mesh: Mesh, axis_name: str, cfg: ModelConfig):

@@ -7,10 +7,9 @@ there is exactly one worker process: the model is loaded once per process, so
 ``-w 1`` is load-bearing (SURVEY.md §1 L4).
 """
 
-import os
-
-
 def main():
+    from ..utils.config import force_cpu_if_requested, knob
+
     # The reference scales with `gunicorn -w N` (reference
     # docker/Dockerfile.app:12).  On TPU that is the wrong axis: a chip
     # admits ONE claimant process, and N workers would load N copies of
@@ -18,18 +17,16 @@ def main():
     # weight-read serving up to B decode tokens) on one chip, and k8s
     # `replicas` across chips (helm/values.yaml) — so any request for >1
     # worker is refused loudly instead of silently serialized.
-    workers = int(os.environ.get("LFKT_WORKERS", "1"))
+    workers = knob("LFKT_WORKERS")
     if workers != 1:
         raise SystemExit(
             f"LFKT_WORKERS={workers} refused: one worker per process is "
             "load-bearing (a TPU chip admits a single claimant; the model "
             "loads once per process). Scale concurrency with "
             "LFKT_BATCH_SIZE lanes on one chip, or replicas across chips.")
-    from ..utils.config import force_cpu_if_requested
-
     force_cpu_if_requested()   # site-hook defense (one copy: utils/config)
-    host = os.environ.get("LFKT_HOST", "0.0.0.0")
-    port = int(os.environ.get("LFKT_PORT", "8000"))
+    host = knob("LFKT_HOST")
+    port = knob("LFKT_PORT")
     try:
         import uvicorn
     except ImportError:

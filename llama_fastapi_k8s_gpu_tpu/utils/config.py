@@ -1,4 +1,4 @@
-"""Env-overridable runtime settings.
+"""Env-overridable runtime settings — THE ``LFKT_*`` knob registry.
 
 The reference hardcodes all of these as module constants (reference
 api.py:13-19: model dir ``models``, ``MODEL_NAME``, ``MAX_CONTEXT_TOKENS=1024``,
@@ -6,6 +6,16 @@ api.py:13-19: model dir ``models``, ``MODEL_NAME``, ``MAX_CONTEXT_TOKENS=1024``,
 the app as env vars (SURVEY.md §5 "Config / flag system").  Here the same
 defaults are preserved, but every knob can be overridden through the
 environment so the Helm chart can parameterize the app.
+
+Every knob the package reads is declared ONCE, in :data:`KNOBS` below.
+Package code reads knobs only through this module — :func:`get_settings`
+for the Settings-backed ones, :func:`knob` / :func:`env_bool` for ad-hoc
+reads — never ``os.environ`` directly.  That single-source-of-truth is
+machine-enforced by lfkt-lint (rules CFG001-005, docs/LINT.md): a raw
+``os.environ`` read of an LFKT_ name, an unregistered accessor call, an
+undocumented registered knob, and a helm-chart reference to a name this
+registry doesn't know are all tier-1 test failures.  The full catalog with
+defaults and help text: docs/CONFIG.md.
 """
 
 from __future__ import annotations
@@ -14,7 +24,7 @@ import dataclasses
 import os
 
 
-def _env(name: str, default, cast=str):
+def _env(name: str, default, cast=str):  # lfkt: noqa[JIT001] -- trace-time read: kernel-variant knobs are read while jit traces and the value is keyed into every jit/lru cache (ops/pallas/qmatmul._env_variant)
     raw = os.environ.get(name)
     if raw is None:
         return default
@@ -40,14 +50,6 @@ def force_cpu_if_requested() -> bool:
 
     jax.config.update("jax_platforms", "cpu")
     return True
-
-
-def env_bool(name: str, default: bool = False) -> bool:
-    """THE truthy-env convention (one parser: '1'/'true'/'yes'/'on').
-    Direct-engine-construction paths (bench_server.py, models/params.py)
-    must use this instead of re-implementing the tuple and silently
-    diverging on accepted spellings."""
-    return _env(name, default, bool)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -165,54 +167,157 @@ class Settings:
         return sorted(int(x) for x in self.prefill_buckets.split(",") if x.strip())
 
 
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One registered env knob.  ``serving=True`` marks knobs a deployment
+    must be able to set per-pod — lfkt-lint (CFG003) checks they are
+    plumbed or documented in the Helm chart; every knob must additionally
+    appear in docs (CFG002, see docs/CONFIG.md)."""
+
+    name: str
+    cast: type = str
+    help: str = ""
+    serving: bool = False
+    default: object = None          # ad-hoc knobs only; Settings-backed
+    #                                 knobs default from the Settings field
+    field: str | None = None        # Settings field (wired in _register)
+
+
+_SETTINGS_FIELDS = {f.name for f in dataclasses.fields(Settings)}
+
+
+def _register(*knobs: Knob) -> dict[str, Knob]:
+    out: dict[str, Knob] = {}
+    for k in knobs:
+        field = k.name[len("LFKT_"):].lower()
+        if field in _SETTINGS_FIELDS:
+            k = dataclasses.replace(k, field=field)
+        out[k.name] = k
+    return out
+
+
+#: THE registry: every LFKT_* env var any package code reads.  Settings-
+#: backed knobs (the majority) take their default and docstring context
+#: from the Settings field of the same lowercased name; ad-hoc knobs carry
+#: an explicit ``default``.  docs/CONFIG.md mirrors this table.
+KNOBS: dict[str, Knob] = _register(
+    # -- Settings-backed (reference-parity serving surface) ----------------
+    Knob("LFKT_MODEL_DIR", str, "GGUF directory", serving=True),
+    Knob("LFKT_MODEL_NAME", str, "GGUF file name", serving=True),
+    Knob("LFKT_MAX_CONTEXT_TOKENS", int, "context window", serving=True),
+    Knob("LFKT_TIMEOUT_SECONDS", float, "admission future timeout (408)",
+         serving=True),
+    Knob("LFKT_MAX_QUEUE_SIZE", int, "admission queue bound (503)",
+         serving=True),
+    Knob("LFKT_STREAM_DEADLINE_SECONDS", float,
+         "total wall budget of one SSE stream"),
+    Knob("LFKT_DRAIN_SECONDS", float, "graceful-shutdown budget",
+         serving=True),
+    Knob("LFKT_READ_TIMEOUT", float, "httpd slowloris guard (408)"),
+    # -- watchdog / resilience --------------------------------------------
+    Knob("LFKT_WATCHDOG", bool, "enable the engine watchdog"),
+    Knob("LFKT_WATCHDOG_STALL_SECONDS", float, "stalled-decode trip bound"),
+    Knob("LFKT_WATCHDOG_POLL_SECONDS", float, "watchdog sampling period"),
+    Knob("LFKT_WATCHDOG_MAX_RECOVERIES", int, "trips before DEAD"),
+    Knob("LFKT_WATCHDOG_ERROR_BURST", int, "errors per window that trip"),
+    Knob("LFKT_WATCHDOG_ERROR_WINDOW", float, "burst window seconds"),
+    Knob("LFKT_WATCHDOG_BACKOFF_SECONDS", float, "first recovery backoff"),
+    Knob("LFKT_WATCHDOG_BACKOFF_MAX", float, "recovery backoff ceiling"),
+    # -- sampling (reference api.py:59-62 + llama-cpp-python defaults) -----
+    Knob("LFKT_TEMPERATURE", float, "sampling temperature"),
+    Knob("LFKT_TOP_P", float, "nucleus sampling mass"),
+    Knob("LFKT_FREQUENCY_PENALTY", float, "frequency penalty"),
+    Knob("LFKT_PRESENCE_PENALTY", float, "presence penalty"),
+    Knob("LFKT_TOP_K", int, "top-k cutoff"),
+    Knob("LFKT_MIN_P", float, "min-p cutoff"),
+    Knob("LFKT_REPEAT_PENALTY", float, "repetition penalty"),
+    # -- TPU-native engine knobs -------------------------------------------
+    Knob("LFKT_MAX_GEN_TOKENS", int, "default completion budget"),
+    Knob("LFKT_DECODE_CHUNK", int, "device tokens per host round-trip"),
+    Knob("LFKT_PREFILL_BUCKETS", str, "padded prompt shapes (csv)"),
+    Knob("LFKT_WEIGHT_FORMAT", str, "auto|bf16|int8|q4k"),
+    Knob("LFKT_ATTN_IMPL", str, "auto|xla|pallas"),
+    Knob("LFKT_KV_DTYPE", str, "bf16|int8 KV cache (docs/KV_CACHE.md)"),
+    Knob("LFKT_SPEC_DECODE", str, "off|lookup|auto speculation"),
+    Knob("LFKT_SPEC_DRAFT", int, "draft tokens per verify step"),
+    Knob("LFKT_PREFIX_CACHE", bool, "serial-engine prompt-prefix KV reuse"),
+    Knob("LFKT_LANE_PREFIX_CACHE", bool, "lane-claim admission KV reuse"),
+    Knob("LFKT_PREFILL_CHUNK", int, "scheduler admission slice tokens"),
+    Knob("LFKT_ADM_BUDGET", int, "admission tokens per scheduler iteration"),
+    Knob("LFKT_BATCH_SIZE", int, "serving lanes (mesh/continuous batching)"),
+    Knob("LFKT_SCHEDULER", str, "continuous|cycle batching flavor"),
+    Knob("LFKT_MESH_TP", int, "tensor-parallel width"),
+    Knob("LFKT_MESH_SP", int, "sequence-parallel ring size"),
+    # -- ad-hoc knobs (read via knob()/env_bool(), not Settings) -----------
+    Knob("LFKT_HOST", str, "bind address (server/__main__.py)",
+         default="0.0.0.0"),
+    Knob("LFKT_PORT", int, "bind port (server/__main__.py)", default=8000),
+    Knob("LFKT_WORKERS", int, "must stay 1: one model per process",
+         default=1),
+    Knob("LFKT_COMPILE_CACHE_DIR", str,
+         "persistent XLA compile cache (utils/jaxcache.py)", serving=True,
+         default=""),
+    Knob("LFKT_PROFILE_DIR", str,
+         "capture XProf traces per generation (utils/tracing.py)",
+         default=""),
+    Knob("LFKT_NATIVE", bool, "C++ GGUF load path (0 forces numpy)",
+         default=True),
+    Knob("LFKT_LOAD_OVERLAP", bool,
+         "overlap per-layer host→device transfer with dequant",
+         default=True),
+    Knob("LFKT_HBM_GBPS", float,
+         "assumed HBM bandwidth for spec_decode=auto breakeven",
+         default=819.0),
+    Knob("LFKT_SPEC_AUTO_ACCEPT", float,
+         "assumed lookup acceptance for spec_decode=auto", default=1.0),
+    Knob("LFKT_FAULTS", str,
+         "fault-injection arming spec (utils/faults.py; drills only)",
+         default=""),
+    Knob("LFKT_Q4K_KERNEL", str, "fused Q4_K kernel variant (A/B)",
+         default=""),
+    Knob("LFKT_Q5K_KERNEL", str, "fused Q5_K kernel variant (A/B)",
+         default=""),
+    Knob("LFKT_Q6K_KERNEL", str, "fused Q6_K kernel variant (A/B)",
+         default=""),
+)
+
+
+def knob(name: str, default=None, cast=None):
+    """Registered ad-hoc env read — the ONLY way package code outside this
+    module reads an ``LFKT_*`` var (lfkt-lint CFG001/CFG005).  ``default``
+    overrides the registry default at call sites whose natural default is
+    contextual (e.g. kernel-variant tables)."""
+    k = KNOBS.get(name)
+    if k is None:
+        raise KeyError(
+            f"{name} is not in the LFKT knob registry (utils/config.py); "
+            "register it before reading it")
+    if default is None:
+        # Settings-backed knobs keep their documented default even through
+        # this accessor (their Knob.default is None by construction);
+        # ad-hoc knobs carry theirs on the Knob entry
+        default = k.default if k.field is None else getattr(Settings, k.field)
+    return _env(name, default, cast or k.cast)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    """THE truthy-env convention (one parser: '1'/'true'/'yes'/'on').
+    Direct-engine-construction paths (bench_server.py, models/params.py)
+    must use this instead of re-implementing the tuple and silently
+    diverging on accepted spellings.  LFKT_* names must be registered."""
+    if name.startswith("LFKT_") and name not in KNOBS:
+        raise KeyError(
+            f"{name} is not in the LFKT knob registry (utils/config.py); "
+            "register it before reading it")
+    return _env(name, default, bool)
+
+
 def get_settings() -> Settings:
-    return Settings(
-        model_dir=_env("LFKT_MODEL_DIR", Settings.model_dir),
-        model_name=_env("LFKT_MODEL_NAME", Settings.model_name),
-        max_context_tokens=_env("LFKT_MAX_CONTEXT_TOKENS", Settings.max_context_tokens, int),
-        timeout_seconds=_env("LFKT_TIMEOUT_SECONDS", Settings.timeout_seconds, float),
-        drain_seconds=_env("LFKT_DRAIN_SECONDS", Settings.drain_seconds, float),
-        read_timeout=_env("LFKT_READ_TIMEOUT", Settings.read_timeout, float),
-        watchdog=_env("LFKT_WATCHDOG", Settings.watchdog, bool),
-        watchdog_stall_seconds=_env("LFKT_WATCHDOG_STALL_SECONDS",
-                                    Settings.watchdog_stall_seconds, float),
-        watchdog_poll_seconds=_env("LFKT_WATCHDOG_POLL_SECONDS",
-                                   Settings.watchdog_poll_seconds, float),
-        watchdog_max_recoveries=_env("LFKT_WATCHDOG_MAX_RECOVERIES",
-                                     Settings.watchdog_max_recoveries, int),
-        watchdog_error_burst=_env("LFKT_WATCHDOG_ERROR_BURST",
-                                  Settings.watchdog_error_burst, int),
-        watchdog_error_window=_env("LFKT_WATCHDOG_ERROR_WINDOW",
-                                   Settings.watchdog_error_window, float),
-        watchdog_backoff_seconds=_env("LFKT_WATCHDOG_BACKOFF_SECONDS",
-                                      Settings.watchdog_backoff_seconds, float),
-        watchdog_backoff_max=_env("LFKT_WATCHDOG_BACKOFF_MAX",
-                                  Settings.watchdog_backoff_max, float),
-        max_queue_size=_env("LFKT_MAX_QUEUE_SIZE", Settings.max_queue_size, int),
-        stream_deadline_seconds=_env("LFKT_STREAM_DEADLINE_SECONDS",
-                                     Settings.stream_deadline_seconds, float),
-        temperature=_env("LFKT_TEMPERATURE", Settings.temperature, float),
-        top_p=_env("LFKT_TOP_P", Settings.top_p, float),
-        frequency_penalty=_env("LFKT_FREQUENCY_PENALTY", Settings.frequency_penalty, float),
-        presence_penalty=_env("LFKT_PRESENCE_PENALTY", Settings.presence_penalty, float),
-        top_k=_env("LFKT_TOP_K", Settings.top_k, int),
-        min_p=_env("LFKT_MIN_P", Settings.min_p, float),
-        repeat_penalty=_env("LFKT_REPEAT_PENALTY", Settings.repeat_penalty, float),
-        max_gen_tokens=_env("LFKT_MAX_GEN_TOKENS", Settings.max_gen_tokens, int),
-        decode_chunk=_env("LFKT_DECODE_CHUNK", Settings.decode_chunk, int),
-        prefill_buckets=_env("LFKT_PREFILL_BUCKETS", Settings.prefill_buckets),
-        weight_format=_env("LFKT_WEIGHT_FORMAT", Settings.weight_format),
-        attn_impl=_env("LFKT_ATTN_IMPL", Settings.attn_impl),
-        kv_dtype=_env("LFKT_KV_DTYPE", Settings.kv_dtype),
-        spec_decode=_env("LFKT_SPEC_DECODE", Settings.spec_decode),
-        spec_draft=_env("LFKT_SPEC_DRAFT", Settings.spec_draft, int),
-        prefix_cache=_env("LFKT_PREFIX_CACHE", Settings.prefix_cache, bool),
-        lane_prefix_cache=_env("LFKT_LANE_PREFIX_CACHE",
-                               Settings.lane_prefix_cache, bool),
-        prefill_chunk=_env("LFKT_PREFILL_CHUNK", Settings.prefill_chunk, int),
-        adm_budget=_env("LFKT_ADM_BUDGET", Settings.adm_budget, int),
-        batch_size=_env("LFKT_BATCH_SIZE", Settings.batch_size, int),
-        scheduler=_env("LFKT_SCHEDULER", Settings.scheduler),
-        mesh_tp=_env("LFKT_MESH_TP", Settings.mesh_tp, int),
-        mesh_sp=_env("LFKT_MESH_SP", Settings.mesh_sp, int),
-    )
+    """Build Settings from the registry: every Settings-backed knob reads
+    its env var with the Settings field's default — the registry and the
+    dataclass cannot drift (tests/test_lint.py pins the mapping)."""
+    kw = {}
+    for name, k in KNOBS.items():
+        if k.field is not None:
+            kw[k.field] = _env(name, getattr(Settings, k.field), k.cast)
+    return Settings(**kw)

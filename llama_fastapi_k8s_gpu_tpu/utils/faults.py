@@ -33,7 +33,6 @@ a no-op returning on the first branch.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 
@@ -75,14 +74,21 @@ class _Fault:
 class FaultInjector:
     """Holds armed faults; engines call :meth:`fire` at injection points."""
 
+    # arm/disarm (test threads) race fire (engine threads): the table is
+    # written under _lock; fire's first read is a deliberate lock-free
+    # dict probe (disarmed is the hot path) — reads aren't write-checked
+    _GUARDED_BY = {"_by_point": "_lock"}
+
     def __init__(self):
         self._lock = threading.Lock()
         self._by_point: dict[str, _Fault] = {}
 
     @classmethod
     def from_env(cls, var: str = "LFKT_FAULTS") -> "FaultInjector":
+        from .config import knob
+
         inj = cls()
-        spec = os.environ.get(var, "").strip()
+        spec = knob(var, default="").strip()
         if spec:
             inj.arm(spec)
             logger.warning("fault injection ARMED from %s=%r", var, spec)

@@ -65,6 +65,14 @@ class Heartbeat:
     a wedged decode loop and a hung device call, with zero cost on the
     no-fault path beyond a lock-guarded float store."""
 
+    # every field is written by engine threads and read by the watchdog
+    # thread: all access goes through _lock (lfkt-lint LOCK001)
+    _GUARDED_BY = {
+        "_last_beat": "_lock", "_busy": "_lock", "_errors": "_lock",
+        "beats_total": "_lock", "errors_total": "_lock",
+        "last_error": "_lock",
+    }
+
     def __init__(self, error_keep: int = 32):
         self._lock = threading.Lock()
         self._last_beat = time.monotonic()
@@ -136,6 +144,13 @@ class HealthMonitor:
     DEAD is terminal: once the recovery budget is spent the only exit is a
     pod restart (liveness probe fails), so nothing may transition out of
     it.  Every transition is recorded (bounded log) for /health."""
+
+    # probe handlers, the watchdog and SIGTERM handling all race on the
+    # state: every read/write goes through _lock (lfkt-lint LOCK001)
+    _GUARDED_BY = {
+        "_state": "_lock", "_reason": "_lock", "_since": "_lock",
+        "_log": "_lock",
+    }
 
     def __init__(self):
         self._lock = threading.Lock()

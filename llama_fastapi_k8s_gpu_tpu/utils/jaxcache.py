@@ -11,13 +11,14 @@ otherwise each pay the full remote-compile cost of the same programs.
 from __future__ import annotations
 
 import logging
-import os
 
 logger = logging.getLogger(__name__)
 
 
 def setup_compile_cache() -> None:
-    d = os.environ.get("LFKT_COMPILE_CACHE_DIR")
+    from .config import knob
+
+    d = knob("LFKT_COMPILE_CACHE_DIR")
     if not d:
         return
     import jax

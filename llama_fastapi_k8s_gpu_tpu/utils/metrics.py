@@ -13,6 +13,11 @@ from collections import defaultdict
 
 
 class Metrics:
+    # inc/observe run on handler+engine+watchdog threads concurrently with
+    # the /metrics render: every store goes through _lock (lfkt-lint LOCK001)
+    _GUARDED_BY = {"_counters": "_lock", "_gauges": "_lock",
+                   "_summaries": "_lock"}
+
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, float] = defaultdict(float)

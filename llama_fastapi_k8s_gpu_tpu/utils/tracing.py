@@ -19,7 +19,9 @@ logger = logging.getLogger(__name__)
 
 
 def profile_dir() -> str | None:
-    return os.environ.get("LFKT_PROFILE_DIR") or None
+    from .config import knob
+
+    return knob("LFKT_PROFILE_DIR") or None
 
 
 @contextlib.contextmanager

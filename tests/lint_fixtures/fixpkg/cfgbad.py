@@ -1,0 +1,25 @@
+"""Planted CFG001/CFG005 violations (see ../README.md)."""
+
+import os
+
+from .utils.config import knob
+
+
+def raw_reads():
+    a = os.environ.get("LFKT_RAW_GET")            # CFG001
+    b = os.getenv("LFKT_RAW_GETENV")              # CFG001
+    c = os.environ["LFKT_RAW_SUBSCRIPT"]          # CFG001
+    d = os.environ.get("NOT_OURS")                # fine: not an LFKT_ name
+    return a, b, c, d
+
+
+def suppressed_read():
+    return os.environ.get("LFKT_RAW_OK")  # lfkt: noqa[CFG001] -- fixture: proves suppression works
+
+
+def unregistered_accessor():
+    return knob("LFKT_NOT_REGISTERED")            # CFG005
+
+
+def registered_accessor():
+    return knob("LFKT_DOCUMENTED")                # fine

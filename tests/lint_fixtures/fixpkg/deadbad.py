@@ -1,0 +1,19 @@
+"""Planted DEAD001/DEAD002 violations (see ../README.md)."""
+
+__all__ = ["used_function", "phantom_export"]     # DEAD002: phantom_export
+
+
+def used_function():
+    return unused_helper_suppressed()
+
+
+def totally_unused():                              # DEAD001
+    return 42
+
+
+def unused_helper_suppressed():                    # referenced above: fine
+    return 1
+
+
+def registry_hook():  # lfkt: noqa[DEAD001] -- fixture: reached via getattr at runtime
+    return "looked up by name"

@@ -1,0 +1,38 @@
+"""Planted JIT001-003 violations (see ../README.md)."""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+_COUNTER = 0
+
+
+@jax.jit
+def traced_step(x):
+    t0 = time.time()                      # JIT001
+    flag = os.environ.get("LFKT_DEMO")    # JIT001 (+ CFG005 is out of scope:
+    #                                       raw read → CFG001 elsewhere)
+    noise = np.random.rand()              # JIT001
+    print("tracing", t0, flag, noise)     # JIT001
+    return helper(x) + 1
+
+
+def helper(x):
+    global _COUNTER                       # JIT002 (reachable from traced_step)
+    _COUNTER += 1
+    jax.block_until_ready(x)              # JIT003
+    return x.sum().item()                 # JIT003
+
+
+def host_only(x):
+    # NOT jit-reachable: identical sins, zero findings expected
+    print("host", time.time())
+    return np.asarray(x)
+
+
+@jax.jit
+def suppressed(x):  # lfkt: noqa[JIT001] -- fixture: def-line noqa covers the whole body
+    print("trace-time by design")
+    return x

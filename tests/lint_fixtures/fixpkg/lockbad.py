@@ -1,0 +1,51 @@
+"""Planted LOCK001-004 violations (see ../README.md)."""
+
+import threading
+
+
+class BadEngine:
+    _GUARDED_BY = {"_cache": "_lock", "_ghost": "_no_such_lock"}  # LOCK004
+    _THREAD_ENTRIES = ("_loop", "_phantom_entry")                 # LOCK004
+    _THREAD_CONFINED = ("_owned",)
+    _SHARED_ATOMIC = ("_stop",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+        self._owned = 0
+        self._stop = False
+
+    def good_write(self):
+        with self._lock:
+            self._cache = {"fresh": True}          # guarded: fine
+
+    def bad_write(self):
+        self._cache = {}                           # LOCK001
+
+    def suppressed_write(self):
+        self._cache = {}  # lfkt: noqa[LOCK001] -- fixture: proves suppression works
+
+    def acquire_region_write(self):
+        self._lock.acquire()
+        try:
+            self._cache = {}                       # fine: acquire region
+        finally:
+            self._lock.release()
+
+    def _helper(self):  # lfkt: holds[_lock]
+        self._cache = {}                           # fine: holds marker
+
+    def calls_helper_unlocked(self):
+        self._helper()                             # LOCK003
+
+    def calls_helper_locked(self):
+        with self._lock:
+            self._helper()                         # fine
+
+    def _loop(self):
+        self._owned += 1                           # fine: confined, on-thread
+        self._cache = {}                           # LOCK001 (entry, no lock)
+        self._undeclared = 1                       # LOCK002 (undeclared)
+
+    def off_thread_write(self):
+        self._owned = 0                            # LOCK002 (confined attr)

@@ -1,0 +1,15 @@
+"""Planted suppression-grammar violations (see ../README.md)."""
+
+import os
+
+
+def missing_reason():
+    return os.environ.get("LFKT_NO_REASON")  # lfkt: noqa[CFG001]
+
+
+def unknown_rule():
+    return os.environ.get("LFKT_BAD_RULE")  # lfkt: noqa[CFG999] -- no such rule
+
+
+def empty_rules():
+    return os.environ.get("LFKT_EMPTY")  # lfkt: noqa[] -- names no rule

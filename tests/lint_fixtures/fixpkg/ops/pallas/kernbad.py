@@ -1,0 +1,33 @@
+"""Planted KER001-003 violations (see ../README.md).
+
+No reference from a probe.py and no *xla*/*fallback* function -> KER002.
+"""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def ungated_matmul(x):
+    return pl.pallas_call(                         # KER001: no interpret=
+        _kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(x.shape, lambda i: (0, 0))],
+        out_specs=pl.BlockSpec(x.shape, lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def dynamic_block(x, interpret=False):
+    return pl.pallas_call(
+        _kernel,
+        grid=(1,),
+        # KER003: a call inside the block shape = dynamic extent
+        in_specs=[pl.BlockSpec((int(x.shape[0]), 128), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec(x.shape, lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)

@@ -1,0 +1,28 @@
+"""Contract-conforming kernel module: zero KER findings expected."""
+
+import jax
+from jax.experimental import pallas as pl
+
+TILE = 128
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def _xla_fallback(x):
+    return x * 2
+
+
+def gated_matmul(x, interpret=False):
+    try:
+        return pl.pallas_call(
+            _kernel,
+            grid=(1,),
+            in_specs=[pl.BlockSpec((TILE, TILE), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((TILE, TILE), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=interpret,
+        )(x)
+    except Exception:  # degrade, never crash-loop
+        return _xla_fallback(x)

@@ -1,0 +1,16 @@
+"""Fixture route surface for the CFG004 probe-path cross-check."""
+
+
+class _App:
+    def get(self, path):
+        def deco(fn):
+            return fn
+        return deco
+
+
+app = _App()
+
+
+@app.get("/health/ready")
+async def health_ready():
+    return {"ready": True}

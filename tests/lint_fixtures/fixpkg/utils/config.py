@@ -1,0 +1,24 @@
+"""Fixture knob registry (mirrors the real utils/config.py shape)."""
+
+
+class Knob:
+    def __init__(self, name, cast=str, help="", serving=False, default=None):
+        self.name = name
+        self.cast = cast
+        self.serving = serving
+        self.default = default
+
+
+KNOBS = {
+    k.name: k for k in [
+        Knob("LFKT_DOCUMENTED", str, "appears in docs and helm",
+             serving=True),
+        Knob("LFKT_UNDOCUMENTED", str, "missing from docs -> CFG002"),
+        Knob("LFKT_UNPLUMBED_SERVING", str,
+             "serving=True but absent from helm -> CFG003", serving=True),
+    ]
+}
+
+
+def knob(name, default=None, cast=None):
+    return KNOBS[name].default if default is None else default

@@ -1,0 +1,265 @@
+"""lfkt-lint tier-1 gates (ISSUE 3).
+
+Three layers:
+
+1. **Tree gates** — one test per rule asserting ZERO unsuppressed findings
+   on the real package.  These are the machine-checked invariants: lock
+   discipline, jit purity, the config registry three-way cross-check, the
+   Pallas kernel contract, no dead code.  A failure names the file:line
+   and the rule's fix.
+2. **Self-tests** — the checkers run against a planted-violation fixture
+   tree (tests/lint_fixtures/) and every rule must FIRE where planted;
+   suppressions must suppress; a reasonless or unknown-rule noqa is
+   itself an error.  These prove the gates can't rot into always-green.
+3. **Registry/runtime** — the knob accessors enforce registration at
+   runtime; the registry↔Settings mapping is total; helm's explicit env
+   plumbing and probe paths cross-check against the live registry/routes
+   (the ISSUE's satellite cross-check, asserted directly — not only via
+   the CFG rules).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.lint import all_rules, run_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+
+# ---------------------------------------------------------------------------
+# layer 1: the tree is clean, rule by rule
+# ---------------------------------------------------------------------------
+
+_tree_findings_cache: list | None = None
+
+
+def _tree_findings():
+    global _tree_findings_cache
+    if _tree_findings_cache is None:
+        _tree_findings_cache = run_lint(
+            package_dir=os.path.join(REPO, "llama_fastapi_k8s_gpu_tpu"),
+            repo_root=REPO)
+    return _tree_findings_cache
+
+
+@pytest.mark.parametrize("rule", sorted(all_rules()))
+def test_tree_clean(rule):
+    live = [f for f in _tree_findings()
+            if f.rule == rule and not f.suppressed]
+    assert not live, "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in live)
+
+
+def test_every_suppression_has_a_reason():
+    # acceptance criterion: every `# lfkt: noqa[...]` carries a reason.
+    # LINT000 covers this, but assert it explicitly so the criterion has a
+    # named test.
+    sup = [f for f in _tree_findings() if f.suppressed]
+    assert sup, "expected at least one audited suppression in the tree"
+    for f in sup:
+        assert f.reason and f.reason.strip(), f.render()
+
+
+# ---------------------------------------------------------------------------
+# layer 2: fixture self-tests — every rule fires where planted
+# ---------------------------------------------------------------------------
+
+_fix_findings_cache: list | None = None
+
+
+def _fix_findings():
+    global _fix_findings_cache
+    if _fix_findings_cache is None:
+        _fix_findings_cache = run_lint(
+            package_dir=os.path.join(FIXTURES, "fixpkg"), repo_root=FIXTURES)
+    return _fix_findings_cache
+
+
+def _fired(rule, path_part, suppressed=False):
+    return [f for f in _fix_findings()
+            if f.rule == rule and path_part in f.path
+            and f.suppressed == suppressed]
+
+
+@pytest.mark.parametrize("rule,path_part,min_hits", [
+    ("LOCK001", "lockbad.py", 2),   # bad_write + entry-path write
+    ("LOCK002", "lockbad.py", 2),   # undeclared entry write + off-thread
+    ("LOCK003", "lockbad.py", 1),   # holds-marked call without the lock
+    ("LOCK004", "lockbad.py", 2),   # unknown lock + unknown entry method
+    ("JIT001", "jitbad.py", 4),     # time, env, np.random, print
+    ("JIT002", "jitbad.py", 1),     # global in reachable helper
+    ("JIT003", "jitbad.py", 2),     # block_until_ready + .item()
+    ("CFG001", "cfgbad.py", 3),     # get, getenv, subscript
+    ("CFG005", "cfgbad.py", 1),     # unregistered accessor name
+    ("CFG002", "utils/config.py", 1),   # undocumented registered knob
+    ("CFG003", "", 2),              # helm typo'd knob + unplumbed serving
+    ("CFG004", "helm/deployment.yaml", 1),  # phantom probe path
+    ("KER001", "kernbad.py", 1),    # pallas_call without interpret=
+    ("KER002", "kernbad.py", 1),    # no probe, no fallback
+    ("KER003", "kernbad.py", 1),    # call inside a block shape
+    ("DEAD001", "deadbad.py", 1),   # totally_unused
+    ("DEAD002", "deadbad.py", 1),   # phantom __all__ export
+    ("LINT000", "noqabad.py", 1),   # noqa without reason
+    ("LINT001", "noqabad.py", 2),   # unknown rule id + empty rule list
+])
+def test_rule_fires_on_fixture(rule, path_part, min_hits):
+    hits = _fired(rule, path_part)
+    assert len(hits) >= min_hits, (
+        f"{rule} fired {len(hits)}x in {path_part or 'tree'}, "
+        f"expected >= {min_hits}:\n"
+        + "\n".join(f.render() for f in _fix_findings() if f.rule == rule))
+
+
+def test_fixture_contract_conforming_kernel_is_clean():
+    assert not [f for f in _fix_findings()
+                if "kerngood.py" in f.path and f.rule.startswith("KER")]
+
+
+def test_host_only_code_not_flagged_by_jit_rules():
+    # jitbad.host_only commits the same sins as the traced path; it must
+    # produce zero JIT findings (reachability, not grep)
+    jit_lines = [f for f in _fix_findings() if f.rule.startswith("JIT")]
+    host_span = range(29, 34)   # host_only's body in jitbad.py
+    assert not [f for f in jit_lines if f.line in host_span], jit_lines
+
+
+@pytest.mark.parametrize("rule,path_part", [
+    ("LOCK001", "lockbad.py"),      # suppressed_write
+    ("CFG001", "cfgbad.py"),        # suppressed_read
+    ("JIT001", "jitbad.py"),        # def-line noqa covers the body
+    ("DEAD001", "deadbad.py"),      # registry_hook getattr exemption
+])
+def test_noqa_suppresses(rule, path_part):
+    sup = _fired(rule, path_part, suppressed=True)
+    assert sup, f"expected a suppressed {rule} finding in {path_part}"
+    for f in sup:
+        assert f.reason and f.reason.strip(), f.render()
+
+
+def test_good_lock_paths_not_flagged():
+    # with-block, acquire/release region, and holds-marker paths in the
+    # fixture must produce no LOCK001
+    lock1 = {f.line for f in _fired("LOCK001", "lockbad.py")}
+    lock1 |= {f.line for f in _fired("LOCK001", "lockbad.py",
+                                     suppressed=True)}
+    src = open(os.path.join(FIXTURES, "fixpkg", "lockbad.py")).read()
+    for marker in ("# guarded: fine", "# fine: acquire region",
+                   "# fine: holds marker"):
+        line = next(i for i, ln in enumerate(src.splitlines(), 1)
+                    if marker in ln)
+        assert line not in lock1, f"false positive on line {line} ({marker})"
+
+
+# ---------------------------------------------------------------------------
+# layer 3: registry runtime enforcement + helm/docs cross-checks
+# ---------------------------------------------------------------------------
+
+def test_knob_accessors_enforce_registration(monkeypatch):
+    from llama_fastapi_k8s_gpu_tpu.utils.config import env_bool, knob
+
+    with pytest.raises(KeyError):
+        knob("LFKT_NOT_A_KNOB")
+    with pytest.raises(KeyError):
+        env_bool("LFKT_NOT_A_KNOB")
+    # non-LFKT names stay unrestricted for env_bool (generic helper)
+    assert env_bool("SOME_OTHER_VAR", default=True) is True
+    monkeypatch.setenv("LFKT_HBM_GBPS", "512.5")
+    assert knob("LFKT_HBM_GBPS") == 512.5
+    monkeypatch.delenv("LFKT_HBM_GBPS")
+    assert knob("LFKT_HBM_GBPS") == 819.0
+
+
+def test_registry_settings_mapping_total():
+    """Every Settings field is driven by exactly one registered knob and
+    every Settings-backed knob maps to a real field (get_settings cannot
+    silently drop a knob again)."""
+    import dataclasses
+
+    from llama_fastapi_k8s_gpu_tpu.utils.config import KNOBS, Settings
+
+    fields = {f.name for f in dataclasses.fields(Settings)}
+    mapped = {k.field for k in KNOBS.values() if k.field is not None}
+    assert mapped == fields
+    for name, k in KNOBS.items():
+        assert name == "LFKT_" + (k.field or name[5:].lower()).upper()
+
+
+def test_helm_env_names_are_registered():
+    """Satellite cross-check, asserted directly: every LFKT_* in the real
+    chart exists in the registry (modulo the bench-only allowlist)."""
+    from llama_fastapi_k8s_gpu_tpu.lint.configreg import TEST_ONLY_PREFIXES
+    from llama_fastapi_k8s_gpu_tpu.utils.config import KNOBS
+
+    names = set()
+    for dirpath, _, files in os.walk(os.path.join(REPO, "helm")):
+        for fname in files:
+            if fname.endswith((".yaml", ".yml", ".tpl")):
+                with open(os.path.join(dirpath, fname)) as f:
+                    names |= set(re.findall(r"LFKT_[A-Z0-9_]+", f.read()))
+    assert names, "expected LFKT_* references in helm/"
+    unknown = {n for n in names - set(KNOBS)
+               if not n.startswith(TEST_ONLY_PREFIXES)}
+    assert not unknown, f"helm references unregistered knobs: {unknown}"
+
+
+def test_helm_probe_paths_are_registered_routes():
+    """Satellite cross-check: /health/ready + /health/live in the chart
+    must be actual decorated routes in server/app.py."""
+    app_src = open(os.path.join(
+        REPO, "llama_fastapi_k8s_gpu_tpu", "server", "app.py")).read()
+    routes = set(re.findall(r"@app\.(?:get|post)\(\"([^\"]+)\"\)", app_src))
+    dep = open(os.path.join(
+        REPO, "helm", "templates", "deployment.yaml")).read()
+    probes = set(re.findall(r"^\s*path:\s*(/[^\s{]+)\s*$", dep, re.M))
+    assert {"/health/ready", "/health/live"} <= probes
+    missing = probes - routes
+    assert not missing, f"helm probes at unregistered routes: {missing}"
+
+
+def test_registered_knobs_documented_in_config_md():
+    from llama_fastapi_k8s_gpu_tpu.utils.config import KNOBS
+
+    doc = open(os.path.join(REPO, "docs", "CONFIG.md")).read()
+    missing = [n for n in KNOBS if n not in doc]
+    assert not missing, f"docs/CONFIG.md missing knobs: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# the CLI (the CI entrypoint) — exit codes and machine output
+# ---------------------------------------------------------------------------
+
+def test_cli_exits_zero_on_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.lint"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exits_nonzero_on_fixtures_with_json():
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.lint", "--json",
+         "--package", os.path.join(FIXTURES, "fixpkg"),
+         "--root", FIXTURES],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    findings = [json.loads(line) for line in proc.stdout.splitlines()]
+    assert findings and all("rule" in f and "line" in f for f in findings)
+
+
+def test_cli_lists_every_rule():
+    proc = subprocess.run(
+        [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.lint",
+         "--list-rules"], cwd=REPO, capture_output=True, text=True,
+        timeout=60)
+    assert proc.returncode == 0
+    for rule in all_rules():
+        assert rule in proc.stdout

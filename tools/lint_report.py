@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Per-rule lfkt-lint findings table for local use.
+
+``python tools/lint_report.py`` prints one row per rule — findings,
+suppressed count, and description — then any unsuppressed findings in
+full.  The CI/tier-1 entrypoints are ``python -m
+llama_fastapi_k8s_gpu_tpu.lint`` (exit code) and tests/test_lint.py; this
+is the human-friendly overview for working on the tree.
+
+Options mirror the module CLI where useful:
+  --all     also list suppressed findings (with their reasons)
+  --rule R  restrict to one rule ID
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llama_fastapi_k8s_gpu_tpu.lint import all_rules, run_lint  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--all", action="store_true",
+                    help="also list suppressed findings")
+    ap.add_argument("--rule", default=None)
+    args = ap.parse_args()
+
+    rules = all_rules()
+    findings = run_lint(rules=[args.rule] if args.rule else None)
+    by_rule: dict[str, list] = {r: [] for r in rules}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+
+    width = max(len(r) for r in rules)
+    print(f"{'rule':<{width}}  live  supp  description")
+    print("-" * (width + 60))
+    for rule in sorted(by_rule):
+        if args.rule and rule != args.rule:
+            continue
+        fs = by_rule[rule]
+        live = sum(1 for f in fs if not f.suppressed)
+        supp = len(fs) - live
+        mark = " " if live == 0 else "!"
+        print(f"{rule:<{width}}  {live:>4}  {supp:>4}{mark} "
+              f"{rules.get(rule, '?')}")
+
+    live = [f for f in findings if not f.suppressed]
+    if live:
+        print("\nunsuppressed findings:")
+        for f in live:
+            print("  " + f.render())
+    if args.all:
+        supp = [f for f in findings if f.suppressed]
+        if supp:
+            print("\nsuppressed (audited) findings:")
+            for f in supp:
+                print("  " + f.render())
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
