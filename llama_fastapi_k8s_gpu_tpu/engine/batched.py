@@ -141,6 +141,7 @@ class MeshEngine(Engine):
         seed: int | None = None,
         deadlines: Sequence[float | None] | None = None,
         aborts: Sequence | None = None,
+        traces: Sequence | None = None,
     ) -> list[dict]:
         """Generate up to ``batch_size`` completions in one batched program.
         Returns one OpenAI-shaped dict per input, in order.
@@ -170,7 +171,8 @@ class MeshEngine(Engine):
             try:
                 return self._generate_batch(list(batch_messages), sp,
                                             max_tokens, stop, seed,
-                                            deadlines=deadlines, aborts=aborts)
+                                            deadlines=deadlines, aborts=aborts,
+                                            traces=traces)
             except Exception as e:  # noqa: BLE001 — burst detection, re-raised
                 self._note_error(e)
                 raise
@@ -187,9 +189,20 @@ class MeshEngine(Engine):
                 and deadlines[b] is not None and now > deadlines[b])
 
     def _generate_batch(self, batch_messages, sp, max_tokens, stops, seed,
-                        deadlines=None, aborts=None):  # lfkt: holds[_lock]
+                        deadlines=None, aborts=None,
+                        traces=None):  # lfkt: holds[_lock]
         B = self.batch_size
         n_real = len(batch_messages)
+        # per-entry engine spans: entry b's trace gets its own span tree
+        # even though the cycle's device work is shared (the shared-timing
+        # caveat is stamped as an attr); None everywhere when untraced
+        espans: list = [None] * B
+        if traces is not None:
+            for b, tr in enumerate(traces[:B]):
+                if tr is not None:
+                    tr.note(lane=b, tokens=0, **self._trace_attrs())
+                    espans[b] = tr.span("engine").set(
+                        lane=b, shared_cycle=True, **self._trace_attrs())
         dummy = [self.tokenizer.bos_id or 0]
         # An oversized prompt is that request's own input error — it must not
         # fail its batch neighbors (reference semantics are per-request,
@@ -232,6 +245,11 @@ class MeshEngine(Engine):
         }
         first = np.asarray(toks).tolist()  # host sync: TTFT for the batch
         ttft = time.time() - t0
+        for b, es in enumerate(espans):
+            if es is not None:
+                es.child("prefill", t0=t0).set(
+                    n_prompt=len(ids_list[b]), bucket=bucket,
+                    ttft_s=round(ttft, 6)).end()
 
         stop_ids = self.tokenizer.stop_ids
         # Per-lane budget AND per-lane cache capacity: lane b may store
@@ -276,6 +294,7 @@ class MeshEngine(Engine):
             n_steps = min(self.decode_chunk, remaining)
             if n_steps <= 0:
                 break                                 # capacity: "length"
+            t_chunk = time.time()
             state, toks = batched_generate_chunk_jit(
                 self.params, self.cfg, state, st,
                 n_steps=n_steps, top_k=sp.top_k)
@@ -294,8 +313,16 @@ class MeshEngine(Engine):
                     gens[b].append(t)
                 if len(gens[b]) >= budgets[b]:
                     done[b] = True
+                if espans[b] is not None:
+                    espans[b].child("decode_chunk", t0=t_chunk).set(
+                        tokens=len(gens[b])).end()
+                    traces[b].note(tokens=len(gens[b]))
 
         self._bstate = state                          # reuse buffers
+        for b, es in enumerate(espans):
+            if es is not None:
+                es.set(finish=finishes[b], completion_tokens=len(gens[b]))
+                es.end()
         decode_s = time.time() - t0 - ttft
         total_new = sum(len(g) for g in gens[:n_real])
         timings = {

@@ -85,10 +85,11 @@ _STREAM_END = object()   # scheduler→stream-consumer sentinel
 class _Item:
     """One queued request: a future (non-stream) OR a chunk sink (stream)."""
     __slots__ = ("future", "messages", "sp", "max_tokens", "stops", "seed",
-                 "sink", "abandoned", "deadline", "abort", "rid")
+                 "sink", "abandoned", "deadline", "abort", "rid", "trace",
+                 "t_enq")
 
     def __init__(self, future, messages, sp, max_tokens, stops, seed,
-                 sink=None, deadline=None, abort=None):
+                 sink=None, deadline=None, abort=None, trace=None):
         self.future = future
         self.messages = messages
         self.sp = sp
@@ -100,6 +101,8 @@ class _Item:
         self.deadline = deadline            # absolute time.time() budget
         self.abort = abort                  # callable: caller gave up?
         self.rid = 0                        # registry key (abandon/fail_inflight)
+        self.trace = trace                  # obs.trace.Trace | None (sampled out)
+        self.t_enq = time.time()            # pending-span start (tracing only)
 
 
 class _Slot:
@@ -107,7 +110,8 @@ class _Slot:
                  "first_token", "stops", "st", "sp", "t_admit", "ttft_s",
                  "sink", "abandoned", "dec", "n_emitted", "sent_bytes",
                  "held", "cid", "created", "finished", "pending_first",
-                 "reused", "deadline", "abort")
+                 "reused", "deadline", "abort", "trace", "pspan", "dspan",
+                 "t_chunk")
 
     def __init__(self, item: _Item, budget, n_prompt, ids):
         self.future = item.future
@@ -115,6 +119,10 @@ class _Slot:
         self.abandoned = item.abandoned
         self.deadline = item.deadline
         self.abort = item.abort
+        self.trace = item.trace   # span sinks (None when sampled out)
+        self.pspan = None         # the admission's "prefill" span
+        self.dspan = None         # this slot's lane-occupancy "decode" span
+        self.t_chunk = 0.0        # previous harvest time (chunk-span starts)
         self.finished = False   # set when resolved; the pipelined loop may
         #                         still hold this slot in an in-flight
         #                         chunk's lane snapshot — harvest skips it
@@ -234,20 +242,24 @@ class ContinuousEngine(MeshEngine):
                repeat_penalty: float = 1.1, max_tokens: int | None = None,
                stop: Sequence[str] | str | None = None,
                seed: int | None = None,
-               deadline: float | None = None, abort=None) -> Future:
+               deadline: float | None = None, abort=None,
+               trace=None) -> Future:
         """Queue one request; the scheduler admits it to a free lane.
 
         ``top_k`` is served per-request up to the engine's ``max_top_k``
         ceiling (the static k of the shared compiled program); larger values
         are effectively clamped to the ceiling.  ``deadline`` (absolute
         ``time.time()``) frees the request's lane within one decode chunk
-        of expiry, resolving the future with :class:`DeadlineExceeded`."""
+        of expiry, resolving the future with :class:`DeadlineExceeded`.
+        ``trace`` (obs.trace.Trace | None) collects the request's span
+        tree: pending wait, chunked prefill, per-slot occupancy + decode
+        chunks — produced on the scheduler thread."""
         item = self._enqueue(
             messages, temperature=temperature, top_p=top_p, top_k=top_k,
             min_p=min_p, frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty, repeat_penalty=repeat_penalty,
             max_tokens=max_tokens, stop=stop, seed=seed, deadline=deadline,
-            abort=abort)
+            abort=abort, trace=trace)
         fut = item.future
         fut._lfkt_req_id = item.rid
         fut.add_done_callback(
@@ -257,7 +269,7 @@ class ContinuousEngine(MeshEngine):
     def _enqueue(self, messages, *, temperature, top_p, top_k, min_p,
                  frequency_penalty, presence_penalty, repeat_penalty,
                  max_tokens, stop, seed, sink=None, deadline=None,
-                 abort=None) -> _Item:
+                 abort=None, trace=None) -> _Item:
         """Shared submit/submit_stream path: guards, param normalization,
         item construction, registry entry, enqueue + scheduler wake."""
         if self._loop_error is not None:
@@ -273,7 +285,9 @@ class ContinuousEngine(MeshEngine):
             stop = [stop]
         item = _Item(None if sink is not None else Future(), list(messages),
                      sp, max_tokens, list(stop or []), seed, sink=sink,
-                     deadline=deadline, abort=abort)
+                     deadline=deadline, abort=abort, trace=trace)
+        if trace is not None:
+            trace.note(deadline=deadline, tokens=0, **self._trace_attrs())
         with self._id_lock:
             self._req_counter += 1
             item.rid = self._req_counter
@@ -307,7 +321,8 @@ class ContinuousEngine(MeshEngine):
                       max_tokens: int | None = None,
                       stop: Sequence[str] | str | None = None,
                       seed: int | None = None,
-                      deadline: float | None = None, abort=None):
+                      deadline: float | None = None, abort=None,
+                      trace=None):
         """Queue one streaming request; returns an iterator of OpenAI chunk
         dicts produced as the request's lane decodes.  Closing the iterator
         abandons the request (its lane frees at the next chunk boundary).
@@ -318,7 +333,7 @@ class ContinuousEngine(MeshEngine):
             min_p=min_p, frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty, repeat_penalty=repeat_penalty,
             max_tokens=max_tokens, stop=stop, seed=seed, sink=sink,
-            deadline=deadline, abort=abort)
+            deadline=deadline, abort=abort, trace=trace)
 
         def gen():
             try:
@@ -551,6 +566,11 @@ class ContinuousEngine(MeshEngine):
         if item.future is not None and not item.future.set_running_or_notify_cancel():
             return None                                # cancelled while queued
         t0 = time.time()
+        pspan = None
+        if item.trace is not None:
+            # pending: submit -> the scheduler picking this item up
+            item.trace.span("pending", t0=item.t_enq).end(t0)
+            pspan = item.trace.span("prefill", t0=t0)
         try:
             ids = self.tokenize_messages(item.messages)
             if len(ids) >= self.cfg.n_ctx:
@@ -580,6 +600,8 @@ class ContinuousEngine(MeshEngine):
                     self._bstate["cache"], jnp.int32(src))
                 # stats are counted in _finish_admission: an item abandoned
                 # mid-prefill (or failing later) must not inflate /metrics
+            if pspan is not None:
+                pspan.set(n_prompt=len(ids), bucket=bucket, reused=reuse)
             return {
                 "item": item, "ids": ids, "n_prompt": len(ids),
                 "bucket": bucket,
@@ -587,6 +609,7 @@ class ContinuousEngine(MeshEngine):
                 "st": sampling_tensors(item.sp),
                 "seed": item.seed if item.seed is not None else self._next_seed(),
                 "t0": t0, "offset": reuse, "reused": reuse, "logits": None,
+                "span": pspan,
             }
         except Exception as e:  # noqa: BLE001 — per-request isolation
             self._note_error(e)
@@ -618,6 +641,8 @@ class ContinuousEngine(MeshEngine):
         if off <= adm["n_prompt"] - 1 < off + C:
             adm["logits"] = logits
         adm["offset"] = off + C
+        if adm.get("span") is not None:
+            adm["span"].event("prefill_slice", offset=off, tokens=C)
 
     def _finish_admission(self, adm: dict, lane: int, slots: list) -> None:
         """Prefill complete: sample the first token, write the lane, install.
@@ -650,6 +675,7 @@ class ContinuousEngine(MeshEngine):
             slot.st = st
             slot.sp = item.sp
             slot.t_admit = adm["t0"]
+            slot.pspan = adm.get("span")
             slot.reused = adm.get("reused", 0)
             if slot.reused:     # count only realized reuse (lane written)
                 self._prefix_stats["lane_prefix_hits"] += 1
@@ -662,19 +688,35 @@ class ContinuousEngine(MeshEngine):
                 slot.first_token = token        # device array
                 slot.ttft_s = None              # set at materialize
                 slot.pending_first = True
+                self._open_decode_span(lane, slot)
                 slots[lane] = slot
                 return
             slot.first_token = int(token)   # host sync: prefill done = TTFT
             slot.ttft_s = time.time() - adm["t0"]
+            self._end_prefill_span(slot)
             if slot.sink is not None:       # stream: open the chunk stream
                 slot.sink.put(self._chunk(slot, {"role": "assistant"}))
             self._install(lane, slots, slot)
         except Exception as e:  # noqa: BLE001 — per-request isolation
             self._note_error(e)
+            if adm.get("span") is not None:
+                adm["span"].set(error=str(e)).end()
             if item.future is not None and not item.future.done():
                 item.future.set_exception(e)
             elif item.sink is not None:
                 item.sink.put(e)
+
+    def _end_prefill_span(self, slot: _Slot) -> None:
+        """Close the admission's ``prefill`` span at TTFT.  Idempotent —
+        the deadline/abandon path in _harvest re-runs it after a normal
+        close, and the tokens=1 note must not clobber the per-chunk token
+        counts recorded since — so the span reference is consumed here."""
+        if slot.pspan is not None:
+            if slot.ttft_s is not None:
+                slot.pspan.set(ttft_s=round(slot.ttft_s, 6))
+            slot.pspan.end()
+            slot.pspan = None
+            slot.trace.note(tokens=1)
 
     def _materialize_first(self, lane: int, slot: _Slot, slots: list) -> None:
         """Deferred-admission bookkeeping, run at the slot's first harvest
@@ -687,6 +729,7 @@ class ContinuousEngine(MeshEngine):
         except Exception as e:  # noqa: BLE001 — per-request isolation
             self._note_error(e)
             slot.finished = True
+            self._end_prefill_span(slot)
             self._free_lane(lane, slot, slots, claim=False)
             if slot.sink is not None:
                 slot.sink.put(e)
@@ -694,9 +737,27 @@ class ContinuousEngine(MeshEngine):
                 slot.future.set_exception(e)
             return
         slot.ttft_s = time.time() - slot.t_admit
+        self._end_prefill_span(slot)
         if slot.sink is not None:
             slot.sink.put(self._chunk(slot, {"role": "assistant"}))
         self._install(lane, slots, slot)
+
+    def _open_decode_span(self, lane: int, slot: _Slot) -> None:
+        """Start the slot's lane-occupancy ``decode`` span when it takes a
+        lane; per-chunk children hang off it at every harvest.  Idempotent:
+        a deferred admission passes here twice (lane assignment in
+        _finish_admission, then _install at first harvest) and must not
+        leak a second, never-ended span."""
+        if slot.trace is not None and slot.dspan is None:
+            slot.trace.note(lane=lane)
+            slot.dspan = slot.trace.span("decode").set(lane=lane)
+            slot.t_chunk = time.time()
+
+    def _close_decode_span(self, slot: _Slot, finish: str) -> None:
+        if slot.dspan is not None:
+            slot.dspan.set(finish=finish, tokens=len(slot.gens))
+            slot.dspan.end()
+            slot.dspan = None
 
     def _chunk(self, slot: _Slot, delta: dict, finish=None) -> dict:
         return {
@@ -749,6 +810,7 @@ class ContinuousEngine(MeshEngine):
         slot.finished = True
         timings = self._slot_timings(slot)
         self._record_timings(timings)
+        self._close_decode_span(slot, finish)
         if slot.sink is not None:
             hit = self._emit_stream(slot, done=True)
             final = self._chunk(slot, {}, finish=hit or finish)
@@ -800,6 +862,7 @@ class ContinuousEngine(MeshEngine):
                   and self._emit_stream(slot, done=False) == "stop"):
                 self._finish_slot(slot, "stop")
             else:
+                self._open_decode_span(lane, slot)
                 slots[lane] = slot
         if slot.finished:
             # finished at install (or never occupied the lane): its prompt
@@ -826,6 +889,8 @@ class ContinuousEngine(MeshEngine):
                 return 0                        # item resolved/skipped: progress
         adm = self._adm
         if adm["item"].abandoned.is_set():       # caller gave up mid-prefill
+            if adm.get("span") is not None:
+                adm["span"].set(abandoned=True).end()
             self._resolve_skipped(adm["item"])
             self._adm = None
             return 0
@@ -836,6 +901,8 @@ class ContinuousEngine(MeshEngine):
             item = adm["item"]  # failed admission must not kill the scheduler
             self._adm = None
             self._note_error(e)
+            if adm.get("span") is not None:
+                adm["span"].set(error=str(e)).end()
             if item.future is not None:
                 item.future.set_exception(e)
             elif item.sink is not None:
@@ -920,6 +987,9 @@ class ContinuousEngine(MeshEngine):
                 exc = DeadlineExceeded(
                     "request deadline expired mid-generation") if expired \
                     else None
+                self._end_prefill_span(slot)
+                self._close_decode_span(
+                    slot, "deadline" if expired else "abandoned")
                 if slot.sink is not None:
                     slot.sink.put(exc if exc is not None else _STREAM_END)
                 elif not slot.future.done():
@@ -950,6 +1020,12 @@ class ContinuousEngine(MeshEngine):
                 if len(slot.gens) >= slot.budget:
                     finish = "length"
                     break
+            if slot.dspan is not None:
+                slot.dspan.child("decode_chunk", t0=slot.t_chunk).set(
+                    tokens=len(slot.gens),
+                    kind="verify" if counts is not None else "chunk").end(now)
+                slot.t_chunk = now
+                slot.trace.note(tokens=len(slot.gens))
             if finish is not None:
                 self._finish_slot(slot, finish)
                 self._free_lane(lane, slot, slots)

@@ -549,6 +549,7 @@ class Engine:
         seed: int | None = None,
         deadline: float | None = None,
         abort=None,
+        trace=None,
     ):
         """OpenAI-chat-shaped completion (dict), or an iterator of chunks when
         ``stream=True`` (reference call site: api.py:55-63; chunk schema per
@@ -558,7 +559,10 @@ class Engine:
         callable returning True when the caller gave up) propagate the
         server's admission timeout/disconnect into the decode loop: the
         generation stops within one decode chunk of either firing, with
-        ``finish_reason="deadline"``."""
+        ``finish_reason="deadline"``.  ``trace`` (an obs.trace.Trace, or
+        None when the request is sampled out) receives the engine's span
+        tree — prefill and per-decode-chunk timings; every producer site
+        guards on None so an untraced request allocates nothing."""
         if stop is None:
             stop = []
         elif isinstance(stop, str):
@@ -570,13 +574,23 @@ class Engine:
         )
         if stream:
             return self._generate_stream(messages, sp, max_tokens, stop, seed,
-                                         deadline=deadline, abort=abort)
+                                         deadline=deadline, abort=abort,
+                                         trace=trace)
         return self._generate(messages, sp, max_tokens, stop, seed,
-                              deadline=deadline, abort=abort)
+                              deadline=deadline, abort=abort, trace=trace)
+
+    def _trace_attrs(self) -> dict:
+        """Engine-identity attributes stamped on a traced request's
+        ``engine`` span (subclasses extend — engine/sp.py adds the mesh
+        geometry)."""
+        return {"engine": type(self).__name__, "model": self.model_name}
 
     # ------------------------------------------------------------------
-    def _start(self, messages, sp: SamplingParams, seed):  # lfkt: holds[_lock]
-        """Shared prefill + first-token path. Returns a mutable gen context."""
+    def _start(self, messages, sp: SamplingParams, seed,
+               espan=None):  # lfkt: holds[_lock]
+        """Shared prefill + first-token path. Returns a mutable gen context.
+        ``espan`` (the traced request's ``engine`` span, or None) grows a
+        ``prefill`` child covering tokenize → first sampled token."""
         t0 = time.time()
         self.heartbeat.beat()
         FAULTS.fire("prefill")
@@ -601,6 +615,10 @@ class Engine:
         # instead be bit-identical, so they always take the full prefill
         reuse = 0 if explicit_seed else \
             self._prefix_reuse_len(ids, n_prompt, bucket)
+        pspan = None
+        if espan is not None:
+            pspan = espan.child("prefill", t0=t0)
+            pspan.set(n_prompt=n_prompt, bucket=bucket, reused=reuse)
         # claim nothing while this request is in flight: an exception past
         # this point must not leave a stale prefix claim over a cache whose
         # contents are indeterminate
@@ -631,10 +649,14 @@ class Engine:
             "key": key,
         }
         first = int(token)  # device sync: first token is now materialized
+        ttft_s = time.time() - t0
+        if pspan is not None:
+            pspan.set(ttft_s=round(ttft_s, 6))
+            pspan.end()
         return {
             "state": state, "st": st, "sp": sp, "n_prompt": n_prompt,
             "ids": [], "prompt_ids": ids, "first": first, "t0": t0,
-            "reused": reuse, "ttft_s": time.time() - t0,
+            "reused": reuse, "ttft_s": ttft_s, "span": espan,
         }
 
     def _prefix_reuse_len(self, ids: list, n_prompt: int, bucket: int) -> int:
@@ -694,6 +716,11 @@ class Engine:
         if "spec" in ctx:      # speculative decode: acceptance telemetry
             timings["spec"] = ctx["spec"]
         self._record_timings(timings)
+        espan = ctx.get("span")
+        if espan is not None:
+            espan.set(**{k: round(v, 6) if isinstance(v, float) else v
+                         for k, v in timings.items() if not isinstance(v, dict)})
+            espan.end()
         return timings
 
     def _token_budget(self, max_tokens, n_prompt):
@@ -811,12 +838,16 @@ class Engine:
         ready, finish, done = em.step(gen, done, finish)
         if ready:
             yield ready, False, finish
+        espan = ctx.get("span")   # None when untraced: the loop below then
+        #                           allocates no span objects and takes no
+        #                           trace locks (tests/test_obs.py pins it)
         while not done:
             if self._deadline_hit(ctx):
                 finish = "deadline"
                 break
             self.heartbeat.beat()
             FAULTS.fire("decode_step")
+            cspan = espan.child("decode_chunk") if espan is not None else None
             remaining = budget - len(gen)
             capacity = self.cfg.n_ctx - pos - 1   # cache slots left to write
             draft = (self._lookup_draft(history, D)
@@ -849,6 +880,11 @@ class Engine:
                 history.append(t)
             if not done and len(gen) >= budget:
                 done = True
+            if cspan is not None:
+                cspan.set(tokens=len(gen),
+                          kind="verify" if draft is not None else "chunk")
+                cspan.end()
+                ctx["trace"].note(tokens=len(gen))
 
             ready, finish, done = em.step(gen, done, finish)
             if ready:
@@ -909,12 +945,15 @@ class Engine:
         ready, finish, done = em.step(gen, done, finish)
         if ready:
             yield ready, False, finish
+        espan = ctx.get("span")   # None when untraced: no span allocation,
+        #                           no trace lock, anywhere in this loop
         while not done:
             if self._deadline_hit(ctx):
                 finish = "deadline"   # caller timed out/disconnected: free
                 break                 # the device within one decode chunk
             self.heartbeat.beat()
             FAULTS.fire("decode_step")
+            cspan = espan.child("decode_chunk") if espan is not None else None
             # dispatch the NEXT chunk before touching the host copy of the
             # current one (speculating that no stop token appears)
             pos += n_cur
@@ -933,6 +972,10 @@ class Engine:
             pending, n_cur = nxt, n_nxt
             if pending is None:
                 done = True
+            if cspan is not None:
+                cspan.set(tokens=len(gen))
+                cspan.end()
+                ctx["trace"].note(tokens=len(gen))
 
             ready, finish, done = em.step(gen, done, finish)
             if ready:
@@ -943,13 +986,20 @@ class Engine:
         yield tail, True, finish
 
     # ------------------------------------------------------------------
+    def _engine_span(self, trace, deadline):
+        """Open the traced request's ``engine`` span (None passthrough)."""
+        if trace is None:
+            return None
+        trace.note(deadline=deadline, tokens=0, **self._trace_attrs())
+        return trace.span("engine").set(**self._trace_attrs())
+
     def _generate(self, messages, sp, max_tokens, stops, seed,
-                  deadline=None, abort=None) -> dict:
+                  deadline=None, abort=None, trace=None) -> dict:
         with self._lock, maybe_profile("generate"):
             self.heartbeat.enter()
             try:
                 return self._generate_locked(messages, sp, max_tokens, stops,
-                                             seed, deadline, abort)
+                                             seed, deadline, abort, trace)
             except Exception as e:  # noqa: BLE001 — burst detection, re-raised
                 self._note_error(e)
                 raise
@@ -957,9 +1007,12 @@ class Engine:
                 self.heartbeat.leave()
 
     def _generate_locked(self, messages, sp, max_tokens, stops, seed,
-                         deadline, abort) -> dict:  # lfkt: holds[_lock]
+                         deadline, abort, trace=None
+                         ) -> dict:  # lfkt: holds[_lock]
         t0 = time.time()
-        ctx = self._start(messages, sp, seed)
+        ctx = self._start(messages, sp, seed,
+                          espan=self._engine_span(trace, deadline))
+        ctx["trace"] = trace
         ctx["deadline"] = deadline
         ctx["abort"] = abort
         parts = []
@@ -990,15 +1043,18 @@ class Engine:
         }
 
     def _generate_stream(self, messages, sp, max_tokens, stops, seed,
-                         deadline=None, abort=None) -> Iterator[dict]:
+                         deadline=None, abort=None,
+                         trace=None) -> Iterator[dict]:
         with self._lock:
             self.heartbeat.enter()
             try:
-                ctx = self._start(messages, sp, seed)
+                ctx = self._start(messages, sp, seed,
+                                  espan=self._engine_span(trace, deadline))
             except Exception as e:  # noqa: BLE001 — burst detection, re-raised
                 self.heartbeat.leave()
                 self._note_error(e)
                 raise
+            ctx["trace"] = trace
             ctx["deadline"] = deadline
             ctx["abort"] = abort
             cid = f"chatcmpl-{uuid.uuid4().hex}"

@@ -61,6 +61,14 @@ class SPEngine(Engine):
         logger.info("SPEngine: n_ctx=%d over sp=%d tp=%d (%d devices)",
                     self.cfg.n_ctx, sp, tp, sp * tp)
 
+    def _trace_attrs(self) -> dict:
+        """The ``engine`` span / /debug/requests identity, extended with
+        the ring geometry so a slow long-context request's waterfall says
+        which mesh shape served it."""
+        return {**super()._trace_attrs(), "sp": self.sp,
+                "devices": self.sp * self.mesh.shape["tp"],
+                "tp": self.mesh.shape["tp"]}
+
     def _recover_locked(self) -> None:  # lfkt: holds[_lock]
         """Watchdog recovery: the fresh ring must carry the same sp-sharded
         layout __init__ installed — the base class's unsharded init_cache

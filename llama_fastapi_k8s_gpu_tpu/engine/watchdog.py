@@ -34,6 +34,7 @@ import logging
 import threading
 import time
 
+from ..obs.trace import annotate_all_inflight
 from ..utils.health import DEAD, DEGRADED, READY, EngineUnavailable
 
 logger = logging.getLogger(__name__)
@@ -127,6 +128,10 @@ class Watchdog:
         self.last_trip_reason = reason
         self._inc("watchdog_trips_total")
         logger.error("watchdog trip #%d: %s", self.trips, reason)
+        # every in-flight trace carries the trip: the 503s this causes are
+        # then attributable from the trace alone (lfkt-obs)
+        annotate_all_inflight("watchdog_trip", trip=self.trips,
+                              reason=reason)
         self.health.transition(DEGRADED, reason)
         hb = getattr(self.engine, "heartbeat", None)
         if hb is not None:

@@ -21,6 +21,10 @@ This package checks them at test time, on CPU, stdlib-``ast`` only:
 - :mod:`.configreg` — CFG001-005: every LFKT_* env read routes through the
                       utils/config.py registry; registry ↔ docs ↔ Helm
                       three-way cross-check; probe routes exist.
+- :mod:`.obsreg`    — OBS001-002: every metric name recorded into
+                      utils/metrics.py appears in the obs/catalog.py
+                      metric catalog, and the catalog is fully documented
+                      (the docs table is generated from it).
 - :mod:`.kernels`   — KER001-003: Pallas kernels carry an interpret gate,
                       a probe or XLA fallback, and static block shapes.
 - :mod:`.deadcode`  — DEAD001-002: unreferenced module-level functions and

@@ -156,10 +156,10 @@ def _py_files(root: str) -> list[str]:
 
 def _checkers() -> list[tuple[dict, Callable[[Context], list[Finding]]]]:
     # imported lazily so a syntax error in one checker names itself cleanly
-    from . import configreg, deadcode, jit, kernels, locks
+    from . import configreg, deadcode, jit, kernels, locks, obsreg
 
     return [(mod.RULES, mod.check)
-            for mod in (locks, jit, configreg, kernels, deadcode)]
+            for mod in (locks, jit, configreg, obsreg, kernels, deadcode)]
 
 
 def all_rules() -> dict[str, str]:

@@ -1,0 +1,163 @@
+"""THE metric catalog — every series /metrics may expose, declared once.
+
+Same single-source-of-truth pattern as the ``LFKT_*`` knob registry
+(utils/config.py): every metric name the package passes to
+``Metrics.inc/observe/set_gauge`` must be declared here with its type,
+help text and (for histograms) buckets.  The registry is enforced at
+runtime (an unregistered name raises ``KeyError``, utils/metrics.py) and
+statically (lfkt-lint OBS001, lint/obsreg.py); the docs table in
+docs/OBSERVABILITY.md is GENERATED from this module (``python -m
+llama_fastapi_k8s_gpu_tpu.obs.catalog``) and pinned by OBS002 + a tier-1
+test, so a typo'd metric name or an undocumented metric fails the gate.
+
+Engines that synthesize families at runtime (the continuous scheduler's
+``scheduler_stats()`` dict) declare a *prefix family* instead of one entry
+per key — the ``scheduler_`` entry below — mirroring the bench-only knob
+allowlist in lint/configreg.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+#: default latency buckets (seconds): tuned for a serving path whose TTFT
+#: sits in the 0.05-1 s band and whose tail is the 25 s admission timeout
+LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0, 25.0, 60.0)
+#: decode throughput buckets (tokens/sec)
+RATE_BUCKETS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0)
+#: batch occupancy buckets (lanes filled per cycle)
+OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class Metric:
+    """One registered metric family.  ``labels`` names the allowed label
+    keys (order is the render order); ``prefix=True`` registers a family
+    of runtime-synthesized gauges sharing the name as a prefix."""
+
+    name: str
+    mtype: str = COUNTER
+    help: str = ""
+    buckets: tuple = ()
+    labels: tuple = ()
+    prefix: bool = False
+
+
+def _register(*metrics: Metric) -> dict[str, Metric]:
+    out: dict[str, Metric] = {}
+    for m in metrics:
+        if m.mtype == HISTOGRAM and not m.buckets:
+            raise ValueError(f"histogram {m.name} needs explicit buckets")
+        out[m.name] = m
+    return out
+
+
+METRICS: dict[str, Metric] = _register(
+    # -- request path (server/app.py) --------------------------------------
+    Metric("http_requests_total", COUNTER,
+           "requests served, by route and status code",
+           labels=("route", "code")),
+    Metric("request_seconds", HISTOGRAM,
+           "end-to-end request latency, by route",
+           buckets=LATENCY_BUCKETS, labels=("route",)),
+    Metric("queue_wait_seconds", HISTOGRAM,
+           "admission-queue wait (enqueue -> consumer pickup)",
+           buckets=LATENCY_BUCKETS),
+    Metric("generation_seconds", HISTOGRAM,
+           "engine generation wall time (prefill + decode)",
+           buckets=LATENCY_BUCKETS),
+    Metric("queue_depth", GAUGE, "admission queue occupancy"),
+    Metric("requests_rejected_total", COUNTER,
+           "503s from the bounded admission queue"),
+    Metric("requests_timed_out_total", COUNTER,
+           "408s (admission timeout / stream deadline)"),
+    # -- engine phase timings (SURVEY §5 per-phase timers) -----------------
+    Metric("engine_ttft_seconds", HISTOGRAM,
+           "time to first token (prefill + first sample)",
+           buckets=LATENCY_BUCKETS),
+    Metric("engine_decode_tokens_per_sec", HISTOGRAM,
+           "per-request decode throughput",
+           buckets=RATE_BUCKETS),
+    Metric("generated_tokens_total", COUNTER, "completion tokens emitted"),
+    Metric("batched_generations_total", COUNTER,
+           "mesh-batched generation cycles"),
+    Metric("streamed_generations_total", COUNTER, "SSE streams served"),
+    Metric("batch_occupancy", HISTOGRAM,
+           "requests coalesced per batched cycle",
+           buckets=OCCUPANCY_BUCKETS),
+    # -- speculative decoding / prefix reuse -------------------------------
+    Metric("spec_drafted_tokens_total", COUNTER,
+           "speculative tokens drafted"),
+    Metric("spec_accepted_tokens_total", COUNTER,
+           "speculative tokens accepted"),
+    Metric("spec_verify_steps_total", COUNTER, "speculative verify steps"),
+    Metric("spec_fallback_steps_total", COUNTER,
+           "plain decode steps taken on lookup miss"),
+    Metric("prefix_cache_hits_total", COUNTER,
+           "requests served with prompt-prefix KV reuse"),
+    Metric("prefix_cache_reused_tokens_total", COUNTER,
+           "prompt tokens NOT re-prefilled thanks to prefix reuse"),
+    # -- resilience / error taxonomy (docs/RUNBOOK.md) ---------------------
+    Metric("engine_unavailable_total", COUNTER,
+           "503s from watchdog trips / recovery in progress"),
+    Metric("engine_errors_total", COUNTER, "engine-side request failures"),
+    Metric("watchdog_trips_total", COUNTER, "watchdog trip count"),
+    Metric("watchdog_recoveries_total", COUNTER,
+           "successful watchdog recoveries"),
+    Metric("watchdog_escalations_total", COUNTER,
+           "recovery budget exhaustions (DEAD)"),
+    Metric("health_state", GAUGE,
+           "pod health state code (0=STARTING 1=READY 2=DEGRADED "
+           "3=DRAINING 4=DEAD)"),
+    Metric("engine_inflight", GAUGE, "engine busy count (heartbeat)"),
+    Metric("engine_error_count", GAUGE, "heartbeat errors_total"),
+    # -- capacity ----------------------------------------------------------
+    Metric("kv_cache_bytes", GAUGE, "resident KV-cache HBM bytes"),
+    # -- tracer self-telemetry (obs/trace.py) ------------------------------
+    Metric("trace_ring_used", GAUGE, "completed traces held in the ring"),
+    # monotonic tracer counters exported as point-in-time snapshots (the
+    # tracer owns the count; /metrics copies it rather than re-counting)
+    Metric("traces_started_total", GAUGE, "requests that drew a trace"),
+    Metric("traces_sampled_out_total", GAUGE,
+           "requests skipped by LFKT_TRACE_SAMPLE"),
+    # -- runtime-synthesized families --------------------------------------
+    Metric("scheduler_", GAUGE,
+           "continuous-scheduler occupancy family "
+           "(ContinuousEngine.scheduler_stats: lanes_live, pending, "
+           "admission_inflight, spec_*, lane_prefix_*)", prefix=True),
+)
+
+
+def lookup(name: str) -> Metric | None:
+    """The catalog entry governing ``name``: exact match first, then the
+    longest matching declared prefix family."""
+    m = METRICS.get(name)
+    if m is not None:
+        return m
+    best = None
+    for entry in METRICS.values():
+        if entry.prefix and name.startswith(entry.name):
+            if best is None or len(entry.name) > len(best.name):
+                best = entry
+    return best
+
+
+def markdown_table() -> str:
+    """The docs/OBSERVABILITY.md metrics table — generated, never hand
+    edited (tests/test_obs.py pins the docs block to this output)."""
+    rows = ["| metric | type | labels | help |",
+            "|---|---|---|---|"]
+    for m in METRICS.values():
+        name = f"{m.name}*" if m.prefix else m.name
+        labels = ",".join(m.labels) if m.labels else ""
+        rows.append(f"| `{name}` | {m.mtype} | {labels} | {m.help} |")
+    return "\n".join(rows)
+
+
+if __name__ == "__main__":
+    print(markdown_table())

@@ -1,0 +1,92 @@
+"""Request-id log stamping + the structured JSON access-log formatter.
+
+The reference logs free-text lines with no request identity (reference
+api.py:188-193), so correlating a 500 with its access line under
+concurrent traffic is guesswork.  Here the active request id (the trace
+id when sampled, a fresh id otherwise) rides a :mod:`contextvars` context
+variable — it follows the request through ``await`` points AND into
+``asyncio.to_thread`` workers (to_thread copies the context) — and a
+:class:`RequestIdFilter` stamps it onto every log record, so ANY log line
+emitted while serving a request carries ``request_id=...`` without the
+call sites changing.
+
+:class:`JsonFormatter` renders records as one JSON object per line (ts,
+level, logger, message, request_id, plus exception text when present) —
+the machine-parseable access log the k8s log pipeline ingests.  Install
+both with :func:`setup_json_logging` (server/__main__.py does for
+production; tests attach them to private handlers).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+
+#: the active request id ("-" outside any request scope)
+_REQUEST_ID: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "lfkt_request_id", default="-")
+
+
+def current_request_id() -> str:
+    return _REQUEST_ID.get()
+
+
+@contextlib.contextmanager
+def bind_request_id(rid: str):
+    """Scope ``rid`` as the active request id for log stamping."""
+    token = _REQUEST_ID.set(rid)
+    try:
+        yield
+    finally:
+        _REQUEST_ID.reset(token)
+
+
+class RequestIdFilter(logging.Filter):
+    """Stamps ``record.request_id`` from the context variable.  A filter
+    (not a formatter concern) so EVERY formatter downstream — JSON or the
+    default text one — can reference ``%(request_id)s``."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.request_id = _REQUEST_ID.get()
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; always includes the request id."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+            "request_id": getattr(record, "request_id", None)
+            or _REQUEST_ID.get(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        # structured extras attached via logger.*(..., extra={...})
+        for key in ("route", "method", "status", "duration_s"):
+            v = record.__dict__.get(key)
+            if v is not None:
+                out[key] = v
+        return json.dumps(out)
+
+
+#: the access logger server/app.py's timing middleware writes to — one
+#: structured record per served request
+access_logger = logging.getLogger("lfkt.access")
+
+
+def setup_json_logging(logger: logging.Logger | None = None,
+                       stream=None) -> logging.Handler:
+    """Attach a JSON handler (+ request-id filter) to ``logger`` (root by
+    default).  Returns the handler so callers/tests can detach it."""
+    target = logger if logger is not None else logging.getLogger()
+    handler = logging.StreamHandler(stream)
+    handler.addFilter(RequestIdFilter())
+    handler.setFormatter(JsonFormatter())
+    target.addHandler(handler)
+    return handler

@@ -8,7 +8,7 @@ there is exactly one worker process: the model is loaded once per process, so
 """
 
 def main():
-    from ..utils.config import force_cpu_if_requested, knob
+    from ..utils.config import env_bool, force_cpu_if_requested, knob
 
     # The reference scales with `gunicorn -w N` (reference
     # docker/Dockerfile.app:12).  On TPU that is the wrong axis: a chip
@@ -27,6 +27,18 @@ def main():
     force_cpu_if_requested()   # site-hook defense (one copy: utils/config)
     host = knob("LFKT_HOST")
     port = knob("LFKT_PORT")
+    # structured serving logs: one JSON object per line, every record
+    # stamped with the active request id (obs/logctx.py) — the k8s log
+    # pipeline's ingest format; the text format stays for in-tree dev runs
+    if env_bool("LFKT_JSON_LOGS", default=True):
+        import logging
+
+        from ..obs.logctx import setup_json_logging
+
+        root = logging.getLogger()
+        for h in list(root.handlers):   # replace basicConfig's text handler
+            root.removeHandler(h)
+        setup_json_logging()
     try:
         import uvicorn
     except ImportError:

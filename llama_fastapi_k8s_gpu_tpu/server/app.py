@@ -38,6 +38,10 @@ from .asgikit import (
     StreamingResponse,
 )
 
+import uuid
+
+from ..obs.logctx import access_logger, bind_request_id
+from ..obs.trace import TRACER, Tracer
 from ..utils.config import Settings, get_settings
 from ..utils.faults import FAULTS
 from ..utils.health import (
@@ -117,15 +121,18 @@ def build_system_prompt(bot_profile) -> str:
 
 
 def create_app(engine=None, settings: Settings | None = None,
-               engine_factory=None) -> MicroAPI:
+               engine_factory=None, tracer: Tracer | None = None) -> MicroAPI:
     """Build the app. ``engine`` (or ``engine_factory``, called at startup)
     must provide ``create_chat_completion``; defaults to loading the GGUF
-    named by settings — the eager-load equivalent of reference api.py:24-28."""
+    named by settings — the eager-load equivalent of reference api.py:24-28.
+    ``tracer`` defaults to the process-wide lfkt-obs tracer (knobs
+    LFKT_TRACE_SAMPLE / LFKT_TRACE_RING); tests pass private instances."""
     settings = settings or get_settings()
     app = MicroAPI(title="chat-ai (tpu)", version="0.1.0")
     app.state.settings = settings
     app.state.engine = engine
     app.state.metrics = Metrics()
+    app.state.tracer = tracer if tracer is not None else TRACER
     app.state.ready = engine is not None
     #: pod health state machine (utils/health.py): STARTING until the
     #: engine is loaded; the watchdog moves it between READY/DEGRADED/DEAD
@@ -142,6 +149,13 @@ def create_app(engine=None, settings: Settings | None = None,
         app.state.bg_tasks.add(task)
         task.add_done_callback(app.state.bg_tasks.discard)
         return task
+
+    def _queue_span(rd, now: float) -> None:
+        """Record the admission-queue wait (enqueue → consumer pickup) on
+        the request's trace; no-op for sampled-out requests."""
+        tr = rd.get("trace")
+        if tr is not None:
+            tr.span("queue", t0=rd["enqueued_at"]).end(now)
 
     async def consumer():
         """Single drain task: strict FIFO, one generation *cycle* at a time
@@ -162,8 +176,10 @@ def create_app(engine=None, settings: Settings | None = None,
                 # without the cap the engine's pending queue would absorb
                 # unlimited work and 503 could never fire.
                 rd = batch[0]
+                now = time.time()
                 app.state.metrics.observe(
-                    "queue_wait_seconds", time.time() - rd["enqueued_at"])
+                    "queue_wait_seconds", now - rd["enqueued_at"])
+                _queue_span(rd, now)
                 if rd["future"].cancelled():
                     logger.info("Future was cancelled before processing; skipping.")
                 elif "stream_queue" in rd:
@@ -189,6 +205,7 @@ def create_app(engine=None, settings: Settings | None = None,
             for rd in batch:
                 app.state.metrics.observe(
                     "queue_wait_seconds", now - rd["enqueued_at"])
+                _queue_span(rd, now)
                 if rd["future"].cancelled():
                     logger.info("Future was cancelled before processing; skipping.")
                 elif "stream_queue" in rd:
@@ -273,15 +290,18 @@ def create_app(engine=None, settings: Settings | None = None,
                        for c in answer.get("choices", []) if "message" in c)
 
     def _resilience_kw(rd) -> dict:
-        """Deadline/abort propagation kwargs for engines that accept them:
-        the request's admission deadline and a did-the-caller-give-up
-        callback, so a timed-out or disconnected request frees the engine
-        within one decode step (the reference decoded to budget)."""
+        """Deadline/abort/trace propagation kwargs for engines that accept
+        them: the request's admission deadline, a did-the-caller-give-up
+        callback (so a timed-out or disconnected request frees the engine
+        within one decode step — the reference decoded to budget), and the
+        request's trace for the engine's span tree (lfkt-obs)."""
         kw = {}
         if app.state.engine_kw.get("deadline"):
             kw["deadline"] = rd.get("deadline")
         if app.state.engine_kw.get("abort"):
             kw["abort"] = rd["future"].cancelled
+        if app.state.engine_kw.get("trace"):
+            kw["trace"] = rd.get("trace")
         return kw
 
     async def _truncate_and_generate(rd, semaphore) -> str:
@@ -344,6 +364,8 @@ def create_app(engine=None, settings: Settings | None = None,
                     # within one decode chunk instead of pinning the cycle
                     batch_kw["deadlines"] = [rd.get("deadline") for rd in rds]
                     batch_kw["aborts"] = [rd["future"].cancelled for rd in rds]
+                if app.state.engine_kw.get("batch_traces"):
+                    batch_kw["traces"] = [rd.get("trace") for rd in rds]
                 t0 = time.time()
                 answers = await asyncio.to_thread(
                     lambda: app.state.engine.create_chat_completions(
@@ -417,6 +439,8 @@ def create_app(engine=None, settings: Settings | None = None,
                 sub_kw = {}
                 if app.state.engine_kw.get("submit_deadline"):
                     sub_kw["deadline"] = rd.get("deadline")
+                if app.state.engine_kw.get("submit_trace"):
+                    sub_kw["trace"] = rd.get("trace")
                 engine_fut = engine.submit(
                     messages,
                     temperature=settings.temperature,
@@ -541,10 +565,15 @@ def create_app(engine=None, settings: Settings | None = None,
         app.state.engine_kw = {
             "deadline": ccc is not None and _accepts_kwarg(ccc, "deadline"),
             "abort": ccc is not None and _accepts_kwarg(ccc, "abort"),
+            "trace": ccc is not None and _accepts_kwarg(ccc, "trace"),
             "submit_deadline": hasattr(engine, "submit") and _accepts_kwarg(
                 engine.submit, "deadline"),
+            "submit_trace": hasattr(engine, "submit") and _accepts_kwarg(
+                engine.submit, "trace"),
             "batch_deadlines": hasattr(engine, "create_chat_completions")
             and _accepts_kwarg(engine.create_chat_completions, "deadlines"),
+            "batch_traces": hasattr(engine, "create_chat_completions")
+            and _accepts_kwarg(engine.create_chat_completions, "traces"),
         }
         app.state.ready = True
         app.state.health.transition(READY, "engine loaded")
@@ -593,19 +622,25 @@ def create_app(engine=None, settings: Settings | None = None,
         budget = (settings.stream_deadline_seconds
                   if extra and "stream_queue" in extra
                   else settings.timeout_seconds)
+        trace = request.scope.get("lfkt.trace")
         rd = {
             "messages": messages,
             "future": asyncio.get_running_loop().create_future(),
             "enqueued_at": now,
             "deadline": now + budget,
+            "trace": trace,
             **(extra or {}),
         }
         try:
             queue.put_nowait(rd)
         except asyncio.QueueFull:
             m.inc("requests_rejected_total")
+            if trace is not None:
+                trace.event("admission_rejected", queue_depth=queue.qsize())
             raise HTTPException(status_code=503,
                                 detail="Server too busy. Please try again later.")
+        if trace is not None:
+            trace.note(deadline=rd["deadline"])
         m.set_gauge("queue_depth", queue.qsize())
         return rd
 
@@ -644,8 +679,14 @@ def create_app(engine=None, settings: Settings | None = None,
                     extra={"stream_queue": asyncio.Queue()})
         loop = asyncio.get_running_loop()
         deadline = loop.time() + settings.stream_deadline_seconds
+        trace = rd.get("trace")
 
         async def sse():
+            # the SSE write phase outlives the middleware (chunks are sent
+            # after the handler returns), so the stream span AND the trace
+            # itself are closed here, in the generator's finally
+            sspan = trace.span("stream") if trace is not None else None
+            n_events = 0
             try:
                 while True:
                     gap = min(settings.timeout_seconds, deadline - loop.time())
@@ -656,6 +697,8 @@ def create_app(engine=None, settings: Settings | None = None,
                             rd["stream_queue"].get(), timeout=gap)
                     except asyncio.TimeoutError:
                         m.inc("requests_timed_out_total")
+                        if sspan is not None:
+                            sspan.event("stream_timeout")
                         yield ("data: "
                                + json.dumps({"error": "Generation timed out"})
                                + "\n\n")
@@ -667,6 +710,7 @@ def create_app(engine=None, settings: Settings | None = None,
                         yield ("data: "
                                + json.dumps({"error": str(chunk)}) + "\n\n")
                         return
+                    n_events += 1
                     yield "data: " + json.dumps(chunk) + "\n\n"
             finally:
                 # runs on timeout, error, AND client disconnect (the ASGI
@@ -676,6 +720,10 @@ def create_app(engine=None, settings: Settings | None = None,
                 # step instead of streaming to a dead socket until budget
                 if not rd["future"].done():
                     rd["future"].cancel()
+                if sspan is not None:
+                    sspan.set(events=n_events)
+                    sspan.end()
+                app.state.tracer.finish(trace)
 
         return StreamingResponse(sse())
 
@@ -800,24 +848,105 @@ def create_app(engine=None, settings: Settings | None = None,
                         m.set_gauge(f"scheduler_{k}_{kk}", vv)  # invalid lines
                 else:
                     m.set_gauge(f"scheduler_{k}", v)
+        tstats = app.state.tracer.stats()
+        m.set_gauge("trace_ring_used", tstats["ring_used"])
+        m.set_gauge("traces_started_total", tstats["started_total"])
+        m.set_gauge("traces_sampled_out_total", tstats["sampled_out_total"])
         return PlainTextResponse(m.render())
+
+    # -- lfkt-obs debug surface (docs/OBSERVABILITY.md) --------------------
+    @app.get("/debug/traces")
+    async def debug_traces():
+        """Recent completed traces (newest first) + tracer stats; feed the
+        JSON to tools/trace_report.py for latency waterfalls."""
+        t = app.state.tracer
+        return {"stats": t.stats(), "traces": t.traces()}
+
+    @app.get("/debug/traces/{trace_id}")
+    async def debug_trace(trace_id: str):
+        """One trace's full span tree (in-flight or completed)."""
+        tr = app.state.tracer.get(trace_id)
+        if tr is None:
+            raise HTTPException(status_code=404,
+                                detail=f"no trace {trace_id!r} in the ring")
+        return tr.to_dict()
+
+    @app.get("/debug/requests")
+    async def debug_requests():
+        """In-flight request snapshot: engine, slot/lane, deadline
+        remaining, tokens so far — the live answer to "what is this pod
+        doing right now"."""
+        return {"requests": app.state.tracer.inflight()}
 
     @app.get("/items/{item_id}")
     async def read_item(item_id: int):
         # vestigial echo route kept for OpenAPI-surface parity (api.py:175-177)
         return {"item_id": item_id}
 
+    def _route_template(method: str, path: str) -> str:
+        """The matched route's path template — the bounded-cardinality
+        ``route`` label value (``/items/{item_id}``, never ``/items/7``)."""
+        for route in app.router.routes:
+            if route.method == method and route.match(method, path) is not None:
+                return route.path
+        return "unmatched"
+
     @app.middleware("http")
     async def log_request_time(request: Request, call_next):
         start_time = time.time()
-        response = await call_next(request)
-        time_of_day = datetime.now().strftime("%Y-%m-%d %H:%M:%S")
-        process_time = time.time() - start_time
-        app.state.metrics.observe("request_seconds", process_time)
-        logger.info(
-            "Request at %s: %s %s completed in %.4fs",
-            time_of_day, request.method, request.url, process_time,
-        )
+        tracer = app.state.tracer
+        # request identity: ingest the client's W3C traceparent (its trace
+        # id becomes ours) or mint one; sampled-out requests still get a
+        # request id for log stamping, just no span tree
+        trace = tracer.start("request", t0=start_time,
+                             traceparent=request.headers.get("traceparent"))
+        rid = trace.trace_id if trace is not None else uuid.uuid4().hex
+        request.scope["lfkt.trace"] = trace
+        route = _route_template(request.method, request.url.path)
+        if trace is not None:
+            trace.root.set(method=request.method, route=route)
+            trace.note(route=route)
+            httpd_read = request.scope.get("lfkt.httpd_read")
+            if httpd_read is not None:
+                # the in-tree httpd's head+body read window (slowloris
+                # territory), handed through the ASGI scope
+                trace.span("httpd.read", t0=httpd_read[0]).end(httpd_read[1])
+        def finalize(status: int) -> None:
+            time_of_day = datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+            process_time = time.time() - start_time
+            app.state.metrics.observe("request_seconds", process_time,
+                                      route=route)
+            app.state.metrics.inc("http_requests_total", route=route,
+                                  code=str(status))
+            # structured access line: JSON under setup_json_logging, and
+            # the request id rides every record either way
+            access_logger.info(
+                "Request at %s: %s %s completed in %.4fs",
+                time_of_day, request.method, request.url, process_time,
+                extra={"route": route, "method": request.method,
+                       "status": status,
+                       "duration_s": round(process_time, 6)},
+            )
+            if trace is not None:
+                trace.root.set(status=status)
+
+        with bind_request_id(rid):
+            try:
+                response = await call_next(request)
+            except BaseException:
+                # a middleware-layer failure: the outer handler shapes the
+                # response; account for the request and close its trace
+                finalize(500)
+                tracer.finish(trace)
+                raise
+            finalize(response.status_code)
+        response.headers.setdefault("x-request-id", rid)
+        if trace is not None:
+            response.headers.setdefault("traceparent", trace.traceparent())
+            if not isinstance(response, StreamingResponse):
+                # streaming responses finish their trace in the SSE
+                # generator's finally (the body outlives this middleware)
+                tracer.finish(trace)
         return response
 
     return app

@@ -64,6 +64,18 @@ class Request:
         self.url = URL(scope)
         self.path_params: dict[str, Any] = {}
         self._body = body
+        self._headers: dict[str, str] | None = None
+
+    @property
+    def headers(self) -> dict[str, str]:
+        """Lower-cased header map (FastAPI's ``request.headers`` subset) —
+        built lazily; the tracer reads ``traceparent`` from it."""
+        if self._headers is None:
+            self._headers = {
+                k.decode("latin-1").lower(): v.decode("latin-1")
+                for k, v in (self.scope.get("headers") or [])
+            }
+        return self._headers
 
     async def body(self) -> bytes:
         return self._body

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import signal
+import time
 
 logger = logging.getLogger(__name__)
 
@@ -54,9 +55,13 @@ async def _reject(writer, status: int, detail: str) -> bool:
 
 
 async def _handle_request(app, reader, writer, peer, request_line,
-                          state) -> bool:
+                          state, t_read0=None) -> bool:
     """Serve one request on an open connection.  Returns False when the
-    connection must close (malformed request, read deadline, or draining)."""
+    connection must close (malformed request, read deadline, or draining).
+    ``t_read0`` is when this request's bytes started arriving; the
+    completed read window rides the ASGI scope (``lfkt.httpd_read``) so
+    the app's tracer can render an ``httpd.read`` span — a slow client
+    (or a slowloris probe) then shows up as read time, not app time."""
     try:
         method, target, _version = request_line.decode().split()
     except ValueError:
@@ -129,6 +134,8 @@ async def _handle_request(app, reader, writer, peer, request_line,
         "client": peer,
         "scheme": "http",
     }
+    if t_read0 is not None:
+        scope["lfkt.httpd_read"] = (t_read0, time.time())
 
     messages = [{"type": "http.request", "body": body, "more_body": False}]
 
@@ -209,6 +216,7 @@ async def _handle_connection(app, reader: asyncio.StreamReader,
                 # within the read deadline — a dribbled partial line would
                 # otherwise dodge the header/body slowloris guard entirely
                 # (it never reaches _handle_request)
+                t_read0 = time.time()
                 try:
                     request_line = await asyncio.wait_for(
                         reader.readline(), state["read_timeout"])
@@ -225,6 +233,7 @@ async def _handle_connection(app, reader: asyncio.StreamReader,
                 lead = await reader.read(1)
                 if not lead:
                     break
+                t_read0 = time.time()   # idle keep-alive wait excluded
                 try:
                     request_line = lead + await asyncio.wait_for(
                         reader.readline(), state["read_timeout"])
@@ -240,7 +249,8 @@ async def _handle_connection(app, reader: asyncio.StreamReader,
             state["busy"].add(writer)
             try:
                 keep = await _handle_request(app, reader, writer, peer,
-                                             request_line, state)
+                                             request_line, state,
+                                             t_read0=t_read0)
             finally:
                 state["active"] -= 1
                 state["busy"].discard(writer)

@@ -260,6 +260,16 @@ KNOBS: dict[str, Knob] = _register(
     Knob("LFKT_PROFILE_DIR", str,
          "capture XProf traces per generation (utils/tracing.py)",
          default=""),
+    # -- lfkt-obs (obs/trace.py; docs/OBSERVABILITY.md) --------------------
+    Knob("LFKT_TRACE_SAMPLE", float,
+         "fraction of requests traced (0 disarms the tracer)",
+         serving=True, default=1.0),
+    Knob("LFKT_TRACE_RING", int,
+         "completed traces kept for /debug/traces", serving=True,
+         default=256),
+    Knob("LFKT_JSON_LOGS", bool,
+         "JSON access/serving logs with request ids (server/__main__.py)",
+         default=True),
     Knob("LFKT_NATIVE", bool, "C++ GGUF load path (0 forces numpy)",
          default=True),
     Knob("LFKT_LOAD_OVERLAP", bool,

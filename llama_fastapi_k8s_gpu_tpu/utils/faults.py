@@ -148,6 +148,13 @@ class FaultInjector:
             mode, delay = fault.mode, fault.delay
         logger.warning("fault injection FIRING %s at %r (hit %d)",
                        mode, point, fault.seen)
+        # drills only (inert path never reaches here): stamp the injected
+        # fault onto every in-flight trace so the drill's slow/failed
+        # requests are self-explaining in /debug/traces (lfkt-obs).
+        # Local import: faults must stay importable before obs is.
+        from ..obs.trace import annotate_all_inflight
+
+        annotate_all_inflight("fault_fired", point=point, mode=mode)
         if mode == "slow":
             time.sleep(delay)
         elif mode == "oom":

@@ -28,6 +28,8 @@ import threading
 import time
 from collections import deque
 
+from ..obs.trace import annotate_all_inflight
+
 # -- states (string constants: JSON-friendly, no enum dependency) ----------
 STARTING = "STARTING"    # model loading / warmup: not ready, alive
 READY = "READY"          # serving: ready, alive
@@ -181,10 +183,16 @@ class HealthMonitor:
                 "at": time.time(), "from": self._state, "to": state,
                 "reason": reason,
             })
+            prev = self._state
             self._state = state
             self._reason = reason
             self._since = time.time()
-            return True
+        # outside _lock: the tracer takes its own lock, and a state change
+        # is a process-level fact every in-flight trace should carry
+        # (lfkt-obs — a request slowed by a DEGRADED window says so)
+        annotate_all_inflight("health_transition", from_state=prev,
+                              to_state=state, reason=reason)
+        return True
 
     # -- probe semantics ----------------------------------------------------
     def ready(self) -> bool:

@@ -1,61 +1,190 @@
-"""Minimal Prometheus-text metrics registry.
+"""Prometheus-text metrics registry: labeled series + true histograms.
 
-The reference README advertises "metrics, alerts" (reference README.md:9) with
-no implementation (SURVEY.md §5 "Metrics"); this makes the claim true: queue
-depth, request counters, and latency/TTFT summaries exposed at ``/metrics``.
-No external client library — the text exposition format is trivial.
+The first cut of this module rendered ad-hoc ``{name}_min/_max/_avg``
+lines under a ``summary`` TYPE with no ``# HELP`` — non-standard
+exposition a real Prometheus scraper rejects, and min/max/avg cannot
+answer tail-latency questions anyway.  Now:
+
+- every family the package may expose is declared ONCE in the metric
+  catalog (obs/catalog.py — the same single-source-of-truth pattern as
+  the LFKT_* knob registry), with type, help text, allowed label keys and
+  histogram buckets; an unregistered name raises ``KeyError`` here at
+  runtime and fails lfkt-lint OBS001 statically;
+- ``observe`` feeds an explicit-bucket **histogram** (cumulative
+  ``_bucket{le="..."}`` + ``_sum`` + ``_count``) and the render derives
+  p50/p95/p99 gauges per series (``{name}_p50`` ...) via the standard
+  intra-bucket linear interpolation, replacing the summary hack for
+  ``request_seconds``, ``engine_ttft_seconds``,
+  ``engine_decode_tokens_per_sec`` and ``queue_wait_seconds``;
+- counters/gauges/histograms all accept **labels** (keyword arguments
+  matching the catalog's declared label keys), rendered as
+  ``name{k="v"}`` series;
+- the exposition text is legal: one ``# HELP`` + one ``# TYPE`` per
+  family, families contiguous, values finite-formatted — asserted by the
+  format-validation test in tests/test_obs.py.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
-from collections import defaultdict
+
+from ..obs.catalog import COUNTER, GAUGE, HISTOGRAM, Metric, lookup
+
+#: derived-quantile gauge suffixes rendered for every histogram series
+QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample formatting: integers without the trailing .0."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class _Series:
+    """One labelset's storage: a scalar for counter/gauge, buckets+sum for
+    a histogram."""
+
+    __slots__ = ("value", "buckets", "total", "count")
+
+    def __init__(self, metric: Metric):
+        self.value = 0.0
+        if metric.mtype == HISTOGRAM:
+            self.buckets = [0] * (len(metric.buckets) + 1)  # + the +Inf bucket
+            self.total = 0.0
+            self.count = 0
+
+    def quantile(self, metric: Metric, q: float) -> float:
+        """histogram_quantile(): linear interpolation inside the bucket the
+        q-th observation falls in — between that bucket's OWN bounds (the
+        lower bound is the previous bucket's bound even when every lower
+        bucket is empty); the +Inf bucket clamps to the largest finite
+        bound (Prometheus convention)."""
+        if not self.count:
+            return 0.0
+        rank = q * self.count
+        cum = 0
+        for i, n in enumerate(self.buckets):
+            if n and cum + n >= rank:
+                if i >= len(metric.buckets):        # +Inf bucket
+                    return float(metric.buckets[-1])
+                hi = float(metric.buckets[i])
+                lo = float(metric.buckets[i - 1]) if i else 0.0
+                return lo + (hi - lo) * ((rank - cum) / n)
+            cum += n
+        return float(metric.buckets[-1])
 
 
 class Metrics:
     # inc/observe run on handler+engine+watchdog threads concurrently with
     # the /metrics render: every store goes through _lock (lfkt-lint LOCK001)
-    _GUARDED_BY = {"_counters": "_lock", "_gauges": "_lock",
-                   "_summaries": "_lock"}
+    _GUARDED_BY = {"_series": "_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = defaultdict(float)
-        self._gauges: dict[str, float] = {}
-        # name -> (sum, count, min, max)
-        self._summaries: dict[str, list[float]] = {}
+        #: name -> { labels_tuple -> _Series }
+        self._series: dict[str, dict[tuple, _Series]] = {}
 
-    def inc(self, name: str, value: float = 1.0):
-        with self._lock:
-            self._counters[name] += value
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve(name: str, mtype: str, labels: dict) -> tuple[Metric, tuple]:
+        metric = lookup(name)
+        if metric is None:
+            raise KeyError(
+                f"metric {name!r} is not in the catalog (obs/catalog.py); "
+                "register it before recording it")
+        if metric.mtype != mtype:
+            raise KeyError(
+                f"metric {name!r} is a {metric.mtype}, recorded as {mtype}")
+        if set(labels) != set(metric.labels):
+            raise KeyError(
+                f"metric {name!r} takes labels {metric.labels}, "
+                f"got {tuple(labels)}")
+        key = tuple(str(labels[k]) for k in metric.labels)
+        return metric, key
 
-    def set_gauge(self, name: str, value: float):
-        with self._lock:
-            self._gauges[name] = value
+    def _get(self, name: str, mtype: str,
+             labels: dict) -> tuple[Metric, _Series]:  # lfkt: holds[_lock]
+        metric, key = self._resolve(name, mtype, labels)
+        by_label = self._series.setdefault(name, {})
+        s = by_label.get(key)
+        if s is None:
+            s = by_label[key] = _Series(metric)
+        return metric, s
 
-    def observe(self, name: str, value: float):
+    # -- producer API ---------------------------------------------------
+    def inc(self, name: str, value: float = 1.0, **labels):
         with self._lock:
-            s = self._summaries.setdefault(name, [0.0, 0.0, float("inf"), float("-inf")])
-            s[0] += value
-            s[1] += 1
-            s[2] = min(s[2], value)
-            s[3] = max(s[3], value)
+            self._get(name, COUNTER, labels)[1].value += value
+
+    def set_gauge(self, name: str, value: float, **labels):
+        with self._lock:
+            self._get(name, GAUGE, labels)[1].value = float(value)
+
+    def observe(self, name: str, value: float, **labels):
+        """Record one observation into the name's histogram."""
+        with self._lock:
+            metric, s = self._get(name, HISTOGRAM, labels)
+            s.buckets[bisect.bisect_left(metric.buckets, float(value))] += 1
+            s.total += float(value)
+            s.count += 1
+
+    # -- exposition ------------------------------------------------------
+    @staticmethod
+    def _label_str(metric: Metric, key: tuple, extra: str = "") -> str:
+        parts = [f'{k}="{_escape(v)}"' for k, v in zip(metric.labels, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
 
     def render(self) -> str:
-        lines = []
+        lines: list[str] = []
         with self._lock:
-            for name, v in sorted(self._counters.items()):
-                lines.append(f"# TYPE {name} counter")
-                lines.append(f"{name} {v}")
-            for name, v in sorted(self._gauges.items()):
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {v}")
-            for name, (total, count, mn, mx) in sorted(self._summaries.items()):
-                lines.append(f"# TYPE {name} summary")
-                lines.append(f"{name}_sum {total}")
-                lines.append(f"{name}_count {count}")
-                if count:
-                    lines.append(f"{name}_min {mn}")
-                    lines.append(f"{name}_max {mx}")
-                    lines.append(f"{name}_avg {total / count}")
+            for name in sorted(self._series):
+                metric = lookup(name)
+                mtype = metric.mtype if not metric.prefix else GAUGE
+                series = self._series[name]
+                lines.append(f"# HELP {name} {metric.help}")
+                lines.append(f"# TYPE {name} {mtype}")
+                if mtype != HISTOGRAM:
+                    for key in sorted(series):
+                        lines.append(
+                            f"{name}{self._label_str(metric, key)} "
+                            f"{_fmt(series[key].value)}")
+                    continue
+                for key in sorted(series):
+                    s = series[key]
+                    cum = 0
+                    for bound, n in zip(metric.buckets, s.buckets):
+                        cum += n
+                        le = f'le="{_fmt(bound)}"'
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{self._label_str(metric, key, le)} {cum}")
+                    inf = 'le="+Inf"'
+                    lines.append(
+                        f"{name}_bucket{self._label_str(metric, key, inf)} "
+                        f"{s.count}")
+                    lines.append(
+                        f"{name}_sum{self._label_str(metric, key)} "
+                        f"{_fmt(s.total)}")
+                    lines.append(
+                        f"{name}_count{self._label_str(metric, key)} "
+                        f"{s.count}")
+                # derived quantiles: separate gauge families (legal — a
+                # histogram family itself may not carry quantile samples)
+                for suffix, q in QUANTILES:
+                    lines.append(
+                        f"# HELP {name}_{suffix} derived {q:.2f} quantile "
+                        f"of {name}")
+                    lines.append(f"# TYPE {name}_{suffix} gauge")
+                    for key in sorted(series):
+                        lines.append(
+                            f"{name}_{suffix}{self._label_str(metric, key)} "
+                            f"{_fmt(series[key].quantile(metric, q))}")
         return "\n".join(lines) + "\n"

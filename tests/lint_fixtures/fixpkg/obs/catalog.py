@@ -1,0 +1,20 @@
+"""Fixture metric catalog (mirrors the real obs/catalog.py shape)."""
+
+
+class Metric:
+    def __init__(self, name, mtype="counter", help="", prefix=False):
+        self.name = name
+        self.mtype = mtype
+        self.help = help
+        self.prefix = prefix
+
+
+METRICS = {
+    m.name: m for m in [
+        Metric("documented_total", "counter", "appears in the fixture docs"),
+        Metric("undocumented_total", "counter",
+               "missing from docs -> OBS002"),
+        Metric("family_", "gauge", "prefix family (documented)",
+               prefix=True),
+    ]
+}

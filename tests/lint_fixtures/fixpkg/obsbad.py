@@ -1,0 +1,27 @@
+"""Planted OBS001 violations (see ../README.md)."""
+
+
+class _Metrics:
+    def inc(self, name, value=1.0):
+        pass
+
+    def observe(self, name, value):
+        pass
+
+    def set_gauge(self, name, value):
+        pass
+
+
+m = _Metrics()
+
+
+def record():
+    m.inc("documented_total")               # fine: cataloged
+    m.set_gauge("family_live_lanes", 3)     # fine: declared prefix family
+    m.inc("typod_total")                    # OBS001
+    m.observe("phantom_seconds", 0.1)       # OBS001
+    m.set_gauge(f"family_{record}", 1)      # fine: dynamic (runtime check)
+
+
+def suppressed_record():
+    m.inc("audited_total")  # lfkt: noqa[OBS001] -- fixture: proves suppression works

@@ -101,6 +101,8 @@ def _fired(rule, path_part, suppressed=False):
     ("CFG002", "utils/config.py", 1),   # undocumented registered knob
     ("CFG003", "", 2),              # helm typo'd knob + unplumbed serving
     ("CFG004", "helm/deployment.yaml", 1),  # phantom probe path
+    ("OBS001", "obsbad.py", 2),     # typo'd inc + phantom observe
+    ("OBS002", "obs/catalog.py", 1),    # undocumented cataloged metric
     ("KER001", "kernbad.py", 1),    # pallas_call without interpret=
     ("KER002", "kernbad.py", 1),    # no probe, no fallback
     ("KER003", "kernbad.py", 1),    # call inside a block shape
@@ -134,6 +136,7 @@ def test_host_only_code_not_flagged_by_jit_rules():
     ("LOCK001", "lockbad.py"),      # suppressed_write
     ("CFG001", "cfgbad.py"),        # suppressed_read
     ("JIT001", "jitbad.py"),        # def-line noqa covers the body
+    ("OBS001", "obsbad.py"),        # audited_total suppression
     ("DEAD001", "deadbad.py"),      # registry_hook getattr exemption
 ])
 def test_noqa_suppresses(rule, path_part):

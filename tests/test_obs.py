@@ -1,0 +1,686 @@
+"""lfkt-obs tier-1 gates (ISSUE 4): tracing, metrics, structured logging.
+
+Four layers:
+
+1. **Metrics registry** — legal Prometheus exposition (HELP + one TYPE
+   per family, cumulative ``_bucket{le=...}`` histograms, derived
+   p50/p95/p99), labeled series, and the runtime catalog enforcement
+   (unregistered/mis-typed names raise).
+2. **Tracer unit behavior** — deterministic sampling, ring eviction
+   bounds, W3C ``traceparent`` ingest, idempotent finish, global-event
+   fan-in, and the zero-cost guarantee for sampled-out requests.
+3. **Engine span trees** — every engine flavor (serial, mesh-batched,
+   continuous, sequence-parallel) produces a complete, monotonic,
+   nested span tree; concurrent load against a real
+   :class:`ContinuousEngine` through the real server yields one complete
+   tree per sampled request.
+4. **Server surface** — /debug endpoints, response headers, request-id
+   stamped JSON access logs, and the generated docs table staying in
+   sync with the catalog.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib.util
+import io
+import json
+import logging
+import os
+import re
+
+import httpx
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.engine import ContinuousEngine, Engine, FakeEngine
+from llama_fastapi_k8s_gpu_tpu.obs.catalog import METRICS, markdown_table
+from llama_fastapi_k8s_gpu_tpu.obs.logctx import (
+    JsonFormatter,
+    bind_request_id,
+    current_request_id,
+    setup_json_logging,
+)
+from llama_fastapi_k8s_gpu_tpu.obs.trace import Tracer, parse_traceparent
+from llama_fastapi_k8s_gpu_tpu.server.app import create_app
+from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+from llama_fastapi_k8s_gpu_tpu.utils.config import Settings
+from llama_fastapi_k8s_gpu_tpu.utils.metrics import Metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MSGS = [{"role": "user", "content": "Say something."}]
+BODY = {
+    "bot_profile": {"name": "Alice.f",
+                    "appearance": "tall,slim,blonde,cats,rain"},
+    "user_profile": {"name": "Bob"},
+    "context": [{"turn": "user", "message": "hi"}],
+}
+#: the tiny byte-level test tokenizer spends ~1 token per character, so
+#: the real-model tests need a short explicit system prompt to fit the
+#: tiny model's 128-token context (the default persona is ~430 chars)
+TINY_BODY = {**BODY, "bot_profile": {**BODY["bot_profile"],
+                                     "system_prompt": "Be brief."}}
+
+EPS = 0.05   # span timestamp slack (clock reads happen around the work)
+
+
+# ---------------------------------------------------------------------------
+# layer 1: the metrics registry
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[a-zA-Z0-9_]+=\"[^\"]*\""
+    r"(,[a-zA-Z0-9_]+=\"[^\"]*\")*\})? (-?[0-9]+(\.[0-9e+-]+)?)$")
+
+
+def validate_exposition(text: str) -> dict:
+    """Assert ``text`` is legal Prometheus exposition; returns
+    family -> type.  A real scraper's constraints: HELP/TYPE once per
+    family, every sample attributable to a typed family, no stray
+    ``_min/_max/_avg`` pseudo-series."""
+    types: dict[str, str] = {}
+    helps: set[str] = set()
+    for ln in text.rstrip("\n").splitlines():
+        if ln.startswith("# HELP "):
+            name = ln.split()[2]
+            assert name not in helps, f"duplicate HELP for {name}"
+            helps.add(name)
+        elif ln.startswith("# TYPE "):
+            _, _, name, mtype = ln.split()
+            assert mtype in ("counter", "gauge", "histogram"), ln
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert name in helps, f"TYPE before HELP for {name}"
+            types[name] = mtype
+        else:
+            m = _SAMPLE_RE.match(ln)
+            assert m, f"illegal sample line: {ln!r}"
+            base = m.group(1)
+            fam = base
+            for suffix in ("_bucket", "_sum", "_count"):
+                stem = base[: -len(suffix)] if base.endswith(suffix) else None
+                if stem and types.get(stem) == "histogram":
+                    fam = stem
+            assert fam in types, f"sample {ln!r} has no TYPE"
+    for name in types:
+        assert not name.endswith(("_min", "_max", "_avg")), (
+            f"summary-hack pseudo-series {name} survived")
+    return types
+
+
+def test_render_is_legal_exposition_with_histograms():
+    m = Metrics()
+    m.inc("requests_rejected_total")
+    m.inc("http_requests_total", route="/response", code="200")
+    m.set_gauge("queue_depth", 3)
+    for v in (0.004, 0.03, 0.03, 0.2, 0.2, 0.2, 0.7, 3.0, 100.0):
+        m.observe("queue_wait_seconds", v)
+    text = m.render()
+    types = validate_exposition(text)
+    assert types["queue_wait_seconds"] == "histogram"
+    assert types["queue_depth"] == "gauge"
+    # cumulative buckets ending at le="+Inf" == count
+    buckets = re.findall(
+        r'queue_wait_seconds_bucket\{le="([^"]+)"\} (\d+)', text)
+    counts = [int(c) for _, c in buckets]
+    assert buckets[-1][0] == "+Inf"
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert counts[-1] == 9
+    assert "queue_wait_seconds_count 9" in text
+    # derived quantiles present, typed as their own gauge families
+    assert types["queue_wait_seconds_p50"] == "gauge"
+    assert types["queue_wait_seconds_p95"] == "gauge"
+    assert types["queue_wait_seconds_p99"] == "gauge"
+
+
+def test_labeled_series_render_and_quantiles_bracket_observations():
+    m = Metrics()
+    m.observe("request_seconds", 0.08, route="/response")
+    m.observe("request_seconds", 0.08, route="/response")
+    m.observe("request_seconds", 22.0, route="/response")
+    m.observe("request_seconds", 0.001, route="/health")
+    text = m.render()
+    assert 'request_seconds_bucket{route="/response",le="0.1"} 2' in text
+    assert 'request_seconds_count{route="/response"} 3' in text
+    assert 'request_seconds_count{route="/health"} 1' in text
+    p50 = float(re.search(
+        r'request_seconds_p50\{route="/response"\} ([0-9.]+)', text).group(1))
+    p99 = float(re.search(
+        r'request_seconds_p99\{route="/response"\} ([0-9.]+)', text).group(1))
+    assert 0.05 <= p50 <= 0.1       # inside the 0.08 observation's bucket
+    assert 10.0 <= p99 <= 25.0      # inside the 22 s observation's bucket
+
+
+def test_runtime_catalog_enforcement():
+    m = Metrics()
+    with pytest.raises(KeyError, match="not in the catalog"):
+        m.inc("request_rejected_total")          # typo'd (singular)
+    with pytest.raises(KeyError, match="is a counter"):
+        m.set_gauge("requests_rejected_total", 1)
+    with pytest.raises(KeyError, match="takes labels"):
+        m.inc("http_requests_total")             # labels missing
+    with pytest.raises(KeyError, match="takes labels"):
+        m.observe("queue_wait_seconds", 0.1, route="/x")   # stray label
+    # declared prefix family admits runtime-synthesized names
+    m.set_gauge("scheduler_lanes_live", 2)
+    m.set_gauge("scheduler_spec_drafted", 5)
+    assert "scheduler_lanes_live 2" in m.render()
+
+
+def test_quantile_uses_target_buckets_own_lower_bound():
+    """Empty lower buckets must not drag the interpolation floor to 0:
+    5 observations all inside (1.0, 2.5] give histogram_quantile p50 of
+    exactly 1.75 (code-review regression)."""
+    m = Metrics()
+    for v in (1.5, 1.8, 2.0, 2.2, 2.4):
+        m.observe("queue_wait_seconds", v)
+    text = m.render()
+    p50 = float(re.search(r"queue_wait_seconds_p50 ([0-9.]+)",
+                          text).group(1))
+    assert p50 == pytest.approx(1.75)
+    assert p50 >= 1.5        # never below the smallest observation's bucket
+
+
+def test_every_catalog_histogram_declares_buckets():
+    for metric in METRICS.values():
+        if metric.mtype == "histogram":
+            assert metric.buckets, metric.name
+            assert list(metric.buckets) == sorted(metric.buckets)
+
+
+# ---------------------------------------------------------------------------
+# layer 2: tracer unit behavior
+# ---------------------------------------------------------------------------
+
+def test_sampling_zero_is_disarmed_and_lock_free():
+    t = Tracer(sample=0.0, ring=8)
+    t._lock = None          # any lock use would AttributeError
+    assert t.start() is None
+    t.annotate_inflight("watchdog_trip", reason="x")   # no-op, no lock
+    t.finish(None)          # None-tolerant
+
+
+def test_sampling_is_deterministic_by_counter():
+    t = Tracer(sample=0.25, ring=64)
+    drawn = [t.start() is not None for _ in range(16)]
+    assert sum(drawn) == 4                      # exactly every 4th
+    assert drawn == [False, False, False, True] * 4
+
+
+def test_ring_eviction_bounds():
+    t = Tracer(sample=1.0, ring=4)
+    ids = []
+    for _ in range(10):
+        tr = t.start()
+        ids.append(tr.trace_id)
+        t.finish(tr)
+    assert t.stats()["ring_used"] == 4
+    kept = [s["trace_id"] for s in t.traces()]
+    assert kept == list(reversed(ids[-4:]))     # newest first, oldest evicted
+    assert t.get(ids[0]) is None                # evicted
+    assert t.get(ids[-1]) is not None
+
+
+def test_traceparent_ingest_and_propagation():
+    tp = "00-" + "ab" * 16 + "-" + "12" * 8 + "-01"
+    assert parse_traceparent(tp) == ("ab" * 16, "12" * 8)
+    for bad in (None, "", "garbage", "01-" + "ab" * 16 + "-" + "12" * 8
+                + "-01", "00-" + "0" * 32 + "-" + "12" * 8 + "-01"):
+        assert parse_traceparent(bad) is None
+    t = Tracer(sample=1.0, ring=4)
+    tr = t.start(traceparent=tp)
+    assert tr.trace_id == "ab" * 16
+    assert tr.parent_span_id == "12" * 8
+    out = tr.traceparent()
+    assert out.startswith("00-" + "ab" * 16 + "-")
+    assert out.split("-")[2] == tr.root.span_id
+    # a fresh trace mints valid ids
+    tr2 = t.start()
+    assert parse_traceparent(tr2.traceparent()) == (tr2.trace_id,
+                                                    tr2.root.span_id)
+
+
+def test_finish_idempotent_and_annotate_targets_only_inflight():
+    t = Tracer(sample=1.0, ring=8)
+    tr_live, tr_done = t.start(), t.start()
+    t.finish(tr_done)
+    t.annotate_inflight("watchdog_trip", reason="stall")
+    t.finish(tr_live)
+    t.finish(tr_live)                            # idempotent
+    assert t.stats()["ring_used"] == 2
+    live = [e["name"] for e in tr_live.root.events]
+    done = [e["name"] for e in tr_done.root.events]
+    assert "watchdog_trip" in live and "watchdog_trip" not in done
+
+
+def test_health_watchdog_and_fault_events_attach_to_inflight_traces():
+    """The process-level fan-in: health transitions, watchdog trips and
+    fault injections ride the module TRACER (the one the serving stack
+    shares) into every in-flight trace as events."""
+    from llama_fastapi_k8s_gpu_tpu.engine.watchdog import Watchdog
+    from llama_fastapi_k8s_gpu_tpu.obs.trace import TRACER
+    from llama_fastapi_k8s_gpu_tpu.utils.faults import FAULTS
+    from llama_fastapi_k8s_gpu_tpu.utils.health import (
+        DEGRADED,
+        READY,
+        HealthMonitor,
+    )
+
+    tr = TRACER.start("request")
+    assert tr is not None, "module tracer must default to sample=1.0"
+    try:
+        h = HealthMonitor()
+        h.transition(READY, "engine loaded")
+        h.transition(DEGRADED, "drill")
+        eng = FakeEngine()
+        wd = Watchdog(eng, h, Metrics())
+        wd.handle_trip("stalled_decode: drill")
+        FAULTS.arm("decode_step:slow:delay=0")
+        try:
+            FAULTS.fire("decode_step")
+        finally:
+            FAULTS.disarm()
+    finally:
+        TRACER.finish(tr)
+    events = [e["name"] for e in tr.root.events]
+    assert "health_transition" in events
+    assert "watchdog_trip" in events
+    assert "fault_fired" in events
+    trip = next(e for e in tr.root.events if e["name"] == "watchdog_trip")
+    assert "stalled_decode" in trip["reason"]
+
+
+def test_events_fan_into_private_tracers_too():
+    """create_app(tracer=...) installs private tracers; process-level
+    events (health/watchdog/faults) must reach their in-flight traces,
+    not only the module default's (code-review regression)."""
+    from llama_fastapi_k8s_gpu_tpu.utils.health import READY, HealthMonitor
+
+    t = Tracer(sample=1.0, ring=4)
+    tr = t.start()
+    try:
+        HealthMonitor().transition(READY, "fan-in probe")
+    finally:
+        t.finish(tr)
+    assert any(e["name"] == "health_transition" for e in tr.root.events)
+
+
+def test_finish_sweeps_open_spans_closed():
+    """A producer error path that leaves a span open (a prefill that
+    raised) must not export end=null: finish closes it at the root's end
+    with an ``auto_closed`` stamp, so waterfalls never show a phantom
+    still-running phase on a completed request."""
+    t = Tracer(sample=1.0, ring=4)
+    tr = t.start()
+    dangling = tr.span("engine")
+    closed = tr.span("queue")
+    closed.end()
+    t.finish(tr)
+    d = tr.to_dict()
+    spans = {c["name"]: c for c in d["root"]["children"]}
+    assert spans["engine"]["end"] == d["root"]["end"]
+    assert spans["engine"]["attrs"].get("auto_closed") is True
+    assert "auto_closed" not in spans["queue"]["attrs"]
+    assert dangling.t1 is not None
+
+
+def test_node_cap_counts_drops():
+    from llama_fastapi_k8s_gpu_tpu.obs.trace import MAX_NODES_PER_TRACE
+    t = Tracer(sample=1.0, ring=2)
+    tr = t.start()
+    for i in range(MAX_NODES_PER_TRACE + 50):
+        tr.span(f"s{i}")
+    d = tr.to_dict()
+    assert len(d["root"]["children"]) == MAX_NODES_PER_TRACE - 1
+    assert d["dropped_nodes"] == 51
+
+
+# ---------------------------------------------------------------------------
+# layer 3: engine span trees (all four engines; ISSUE 4 acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("model") / "tiny.gguf")
+    write_tiny_llama_gguf(path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def cengine(model_path):
+    eng = ContinuousEngine(model_path, dp=2, tp=2, batch_size=4, n_ctx=128,
+                           decode_chunk=4, max_gen_tokens=16,
+                           prefill_buckets=(32, 64, 128))
+    yield eng
+    eng.shutdown()
+
+
+def _spans_by_name(node: dict, out=None) -> dict:
+    out = {} if out is None else out
+    out.setdefault(node["name"], []).append(node)
+    for c in node["children"]:
+        _spans_by_name(c, out)
+    return out
+
+
+def _assert_monotonic_nested(node: dict, lo: float, hi: float, path="root"):
+    """Every span [start, end] sits inside its parent's window (±EPS) and
+    ends after it starts."""
+    assert node["start"] >= lo - EPS, f"{path}/{node['name']} starts early"
+    assert node["end"] is not None, f"{path}/{node['name']} never ended"
+    assert node["end"] >= node["start"], f"{path}/{node['name']} negative"
+    assert node["end"] <= hi + EPS, f"{path}/{node['name']} outlives parent"
+    for c in node["children"]:
+        _assert_monotonic_nested(c, node["start"], node["end"],
+                                 f"{path}/{node['name']}")
+
+
+def _assert_engine_tree(trace_dict: dict, want_decode_chunks: bool = True):
+    root = trace_dict["root"]
+    names = _spans_by_name(root)
+    assert "prefill" in names, sorted(names)
+    prefill = names["prefill"][0]
+    assert prefill["attrs"]["n_prompt"] > 0
+    assert prefill["attrs"].get("ttft_s") is not None
+    if want_decode_chunks:
+        assert "decode_chunk" in names, sorted(names)
+    _assert_monotonic_nested(root, root["start"], root["end"])
+
+
+def test_serial_engine_span_tree(model_path):
+    eng = Engine(model_path, n_ctx=128, decode_chunk=4, max_gen_tokens=16,
+                 prefill_buckets=(32, 64, 128))
+    t = Tracer(sample=1.0, ring=4)
+    tr = t.start()
+    out = eng.create_chat_completion(MSGS, temperature=0.0, max_tokens=12,
+                                     trace=tr)
+    t.finish(tr)
+    assert out["usage"]["completion_tokens"] >= 1
+    d = tr.to_dict()
+    _assert_engine_tree(d)
+    names = _spans_by_name(d["root"])
+    engine_span = names["engine"][0]
+    assert engine_span["attrs"]["engine"] == "Engine"
+    assert engine_span["attrs"]["completion_tokens"] >= 1
+    # streaming rides the same taxonomy
+    tr2 = t.start()
+    list(eng.create_chat_completion(MSGS, stream=True, temperature=0.0,
+                                    max_tokens=8, trace=tr2))
+    t.finish(tr2)
+    _assert_engine_tree(tr2.to_dict())
+
+
+def test_mesh_engine_span_tree(model_path):
+    from llama_fastapi_k8s_gpu_tpu.engine import MeshEngine
+
+    eng = MeshEngine(model_path, dp=2, tp=2, batch_size=2, n_ctx=128,
+                     decode_chunk=4, max_gen_tokens=16,
+                     prefill_buckets=(32, 64, 128))
+    t = Tracer(sample=1.0, ring=4)
+    traces = [t.start(), None]       # entry 1 sampled out: must not trace
+    outs = eng.create_chat_completions([MSGS, MSGS], temperature=0.0,
+                                       max_tokens=8, traces=traces)
+    t.finish(traces[0])
+    assert all(o["usage"]["completion_tokens"] >= 1 for o in outs)
+    d = traces[0].to_dict()
+    _assert_engine_tree(d)
+    assert d["meta"]["engine"] == "MeshEngine"
+    assert d["meta"]["lane"] == 0
+
+
+def test_sp_engine_span_tree(model_path):
+    from llama_fastapi_k8s_gpu_tpu.engine import SPEngine
+
+    eng = SPEngine(model_path, sp=2, tp=1, n_ctx=128, decode_chunk=4,
+                   max_gen_tokens=16, prefill_buckets=(32, 64, 128))
+    t = Tracer(sample=1.0, ring=4)
+    tr = t.start()
+    out = eng.create_chat_completion(MSGS, temperature=0.0, max_tokens=8,
+                                     trace=tr)
+    t.finish(tr)
+    assert out["usage"]["completion_tokens"] >= 1
+    d = tr.to_dict()
+    _assert_engine_tree(d)
+    names = _spans_by_name(d["root"])
+    assert names["engine"][0]["attrs"]["sp"] == 2   # ring geometry stamped
+
+
+def test_continuous_engine_span_tree(cengine):
+    t = Tracer(sample=1.0, ring=8)
+    tr = t.start()
+    out = cengine.submit(MSGS, temperature=0.0, max_tokens=8,
+                         trace=tr).result(timeout=120)
+    t.finish(tr)
+    assert out["usage"]["completion_tokens"] >= 1
+    d = tr.to_dict()
+    names = _spans_by_name(d["root"])
+    for want in ("pending", "prefill", "decode"):
+        assert want in names, sorted(names)
+    assert "decode_chunk" in names
+    decode = names["decode"][0]
+    assert decode["attrs"]["lane"] in range(4)
+    assert decode["attrs"]["finish"] in ("stop", "length")
+    _assert_monotonic_nested(d["root"], d["root"]["start"], d["root"]["end"])
+    assert d["meta"]["engine"] == "ContinuousEngine"
+
+
+def test_zero_cost_when_sampled_out(model_path, monkeypatch):
+    """LFKT_TRACE_SAMPLE=0 ⇒ the decode path may not construct a single
+    span or touch a trace lock: poison Span construction and generate."""
+    import llama_fastapi_k8s_gpu_tpu.obs.trace as trace_mod
+
+    eng = Engine(model_path, n_ctx=128, decode_chunk=4, max_gen_tokens=16,
+                 prefill_buckets=(32, 64, 128))
+    t = Tracer(sample=0.0, ring=4)
+    assert t.start() is None
+
+    def boom(*a, **kw):
+        raise AssertionError("span constructed for a sampled-out request")
+
+    monkeypatch.setattr(trace_mod.Span, "__init__", boom)
+    out = eng.create_chat_completion(MSGS, temperature=0.0, max_tokens=8,
+                                     trace=t.start())
+    assert out["usage"]["completion_tokens"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# layer 3b: concurrent load through the real server on ContinuousEngine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.anyio
+async def test_concurrent_load_trace_completeness(cengine):
+    """N parallel requests against a real ContinuousEngine through the
+    real server: every sampled request yields a COMPLETE span tree —
+    request → queue → pending → prefill → decode(+chunks) — with
+    monotonic, properly nested timestamps (ISSUE 4 acceptance)."""
+    tracer = Tracer(sample=1.0, ring=64)
+    app = create_app(engine=cengine,
+                     settings=Settings(batch_size=4, max_queue_size=32,
+                                       timeout_seconds=120),
+                     tracer=tracer)
+    transport = httpx.ASGITransport(app=app)
+    async with transport:
+        await app.router.startup()
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://test") as client:
+            results = await asyncio.gather(*[
+                client.post("/response", json=TINY_BODY) for _ in range(8)])
+        await app.router.shutdown()
+    assert [r.status_code for r in results] == [200] * 8
+    rids = {r.headers["x-request-id"] for r in results}
+    assert len(rids) == 8
+    stats = tracer.stats()
+    assert stats["inflight"] == 0
+    for rid in rids:
+        tr = tracer.get(rid)
+        assert tr is not None, f"request {rid} left no trace"
+        d = tr.to_dict()
+        assert d["finished"]
+        names = _spans_by_name(d["root"])
+        for want in ("queue", "pending", "prefill", "decode",
+                     "decode_chunk"):
+            assert want in names, (rid, sorted(names))
+        assert d["root"]["attrs"]["status"] == 200
+        assert d["root"]["attrs"]["route"] == "/response"
+        _assert_monotonic_nested(d["root"], d["root"]["start"],
+                                 d["root"]["end"])
+        assert d["meta"]["tokens"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# layer 4: server surface — debug endpoints, headers, logs, docs
+# ---------------------------------------------------------------------------
+
+async def _serve(app, calls):
+    transport = httpx.ASGITransport(app=app)
+    out = []
+    async with transport:
+        await app.router.startup()
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://test") as client:
+            for method, path, kw in calls:
+                out.append(await getattr(client, method)(path, **kw))
+        await app.router.shutdown()
+    return out
+
+
+@pytest.mark.anyio
+async def test_debug_endpoints_and_headers():
+    tracer = Tracer(sample=1.0, ring=8)
+    app = create_app(engine=FakeEngine(reply="hey"), tracer=tracer)
+    tp = "00-" + "cd" * 16 + "-" + "34" * 8 + "-01"
+    r1, listing, missing = await _serve(app, [
+        ("post", "/response", {"json": BODY,
+                               "headers": {"traceparent": tp}}),
+        ("get", "/debug/traces", {}),
+        ("get", "/debug/traces/deadbeef", {}),
+    ])
+    # traceparent ingested: its trace id IS the request id
+    assert r1.headers["x-request-id"] == "cd" * 16
+    assert r1.headers["traceparent"].startswith("00-" + "cd" * 16 + "-")
+    assert missing.status_code == 404
+    doc = listing.json()
+    ids = [s["trace_id"] for s in doc["traces"]]
+    assert "cd" * 16 in ids
+    assert doc["stats"]["ring_used"] >= 1
+    # the full tree is servable by id
+    full, = await _serve(app, [("get", f"/debug/traces/{'cd' * 16}", {})])
+    tree = full.json()
+    assert tree["parent_span_id"] == "34" * 8
+    assert tree["root"]["name"] == "request"
+    assert _spans_by_name(tree["root"]).get("queue")
+
+
+@pytest.mark.anyio
+async def test_debug_requests_snapshot_during_flight():
+    tracer = Tracer(sample=1.0, ring=8)
+    app = create_app(engine=FakeEngine(reply="ok", delay=0.5),
+                     tracer=tracer)
+    transport = httpx.ASGITransport(app=app)
+    async with transport:
+        await app.router.startup()
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://test") as client:
+            task = asyncio.create_task(client.post("/response", json=BODY))
+            await asyncio.sleep(0.15)     # mid-generation
+            snap = (await client.get("/debug/requests")).json()["requests"]
+            inflight = [s for s in snap if s["name"] == "request"
+                        and s.get("route") == "/response"]
+            assert inflight, snap
+            assert inflight[0]["age_s"] > 0
+            assert inflight[0]["deadline_remaining_s"] is not None
+            r = await task
+            assert r.status_code == 200
+        await app.router.shutdown()
+    assert tracer.stats()["inflight"] == 0
+
+
+@pytest.mark.anyio
+async def test_request_id_in_json_log_records():
+    stream = io.StringIO()
+    from llama_fastapi_k8s_gpu_tpu.obs.logctx import access_logger
+
+    handler = setup_json_logging(access_logger, stream)
+    access_logger.setLevel(logging.INFO)
+    try:
+        tracer = Tracer(sample=1.0, ring=8)
+        app = create_app(engine=FakeEngine(reply="yo"), tracer=tracer)
+        r, = await _serve(app, [("post", "/response", {"json": BODY})])
+    finally:
+        access_logger.removeHandler(handler)
+    records = [json.loads(ln) for ln in stream.getvalue().splitlines()]
+    access = [rec for rec in records if rec.get("route") == "/response"]
+    assert access, records
+    rec = access[-1]
+    assert rec["request_id"] == r.headers["x-request-id"]
+    assert rec["status"] == 200
+    assert rec["logger"] == "lfkt.access"
+    assert rec["duration_s"] >= 0
+
+
+def test_request_id_contextvar_scoping():
+    assert current_request_id() == "-"
+    with bind_request_id("req-123"):
+        assert current_request_id() == "req-123"
+        rec = logging.LogRecord("x", logging.INFO, __file__, 1, "m", (), None)
+        assert json.loads(JsonFormatter().format(rec))["request_id"] == \
+            "req-123"
+    assert current_request_id() == "-"
+
+
+@pytest.mark.anyio
+async def test_sampled_out_requests_still_get_request_ids():
+    tracer = Tracer(sample=0.0, ring=8)
+    app = create_app(engine=FakeEngine(reply="hi"), tracer=tracer)
+    r1, r2, listing = await _serve(app, [
+        ("post", "/response", {"json": BODY}),
+        ("post", "/response", {"json": BODY}),
+        ("get", "/debug/traces", {}),
+    ])
+    assert r1.headers["x-request-id"] != r2.headers["x-request-id"]
+    assert "traceparent" not in r1.headers       # no trace to propagate
+    assert listing.json()["traces"] == []
+
+
+def test_docs_metrics_table_is_generated_from_catalog():
+    """The docs/OBSERVABILITY.md metrics table IS the catalog generator's
+    output (OBS002's docs coverage, pinned byte-for-byte)."""
+    doc = open(os.path.join(REPO, "docs", "OBSERVABILITY.md"),
+               encoding="utf-8").read()
+    begin = "<!-- metrics:begin (generated - do not hand-edit) -->"
+    assert begin in doc and "<!-- metrics:end -->" in doc
+    block = doc.split(begin)[1].split("<!-- metrics:end -->")[0].strip()
+    assert block == markdown_table().strip(), (
+        "docs/OBSERVABILITY.md metrics table is stale: regenerate with "
+        "python -m llama_fastapi_k8s_gpu_tpu.obs.catalog")
+
+
+# ---------------------------------------------------------------------------
+# tools/trace_report.py — the RUNBOOK waterfall renderer
+# ---------------------------------------------------------------------------
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(REPO, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_waterfall(model_path):
+    eng = Engine(model_path, n_ctx=128, decode_chunk=4, max_gen_tokens=16,
+                 prefill_buckets=(32, 64, 128))
+    t = Tracer(sample=1.0, ring=4)
+    tr = t.start()
+    tr.root.set(route="/response")
+    tr.note(route="/response")
+    eng.create_chat_completion(MSGS, temperature=0.0, max_tokens=8, trace=tr)
+    t.finish(tr)
+    mod = _load_trace_report()
+    text = mod.render_trace(tr.to_dict())
+    assert tr.trace_id in text
+    for phase in ("engine", "prefill", "decode_chunk"):
+        assert phase in text, text
+    assert "phase breakdown:" in text
+    assert re.search(r"engine\s+ +[0-9.]+ ms +[0-9.]+%", text)
+    assert "█" in text
+    listing = mod.render_listing({"traces": t.traces()})
+    assert tr.trace_id in listing

@@ -143,6 +143,8 @@ async def test_health_and_metrics_and_items():
             m = await client.get("/metrics")
             assert m.status_code == 200
             assert "request_seconds_count" in m.text
+            assert 'request_seconds_bucket{' in m.text   # true histograms
+            assert "request_seconds_p95" in m.text       # derived quantiles
             assert "queue_depth" in m.text
             assert "queue_wait_seconds" in m.text  # per-phase timers, SURVEY §5
 
@@ -167,7 +169,12 @@ async def test_metrics_flattens_nested_scheduler_stats():
             assert "scheduler_lanes_live 1" in m.text
             assert "scheduler_spec_drafted 5" in m.text
             assert "scheduler_spec_accepted 3" in m.text
-            assert "{" not in m.text
+            # no dict-valued gauge rendered verbatim (histogram bucket
+            # labels are the only legal brace-bearing lines)
+            for line in m.text.splitlines():
+                if "{" in line:
+                    assert not line.startswith("#"), line
+                    assert "{'" not in line and '="' in line, line
         await app.router.shutdown()
 
 

@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""trace_report — latency waterfalls from lfkt-obs /debug/traces JSON.
+
+The RUNBOOK's slow-request triage flow ("Triaging a slow request",
+docs/RUNBOOK.md): pull a trace, see WHERE the time went — httpd read vs
+queue vs prefill vs decode vs SSE write — as an ASCII timeline plus phase
+percentages, without a tracing backend.
+
+Usage::
+
+    # newest traces from a live server (summaries + the slowest's waterfall)
+    python tools/trace_report.py --url http://localhost:8000
+
+    # one specific request
+    python tools/trace_report.py --url http://localhost:8000 --trace <id>
+
+    # offline: a saved /debug/traces/<id> (or /debug/traces) JSON document
+    python tools/trace_report.py --file trace.json
+
+stdlib only (urllib), no jax import — safe on a serving pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+WIDTH = 56          # timeline columns
+INDENT = 2          # per-depth indent in the name column
+NAME_COL = 26
+
+
+def _fetch(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _walk(span: dict, depth: int = 0):
+    yield span, depth
+    for child in span.get("children", ()):
+        yield from _walk(child, depth + 1)
+
+
+def _fmt_ms(seconds: float | None) -> str:
+    return "     ?" if seconds is None else f"{seconds * 1000.0:6.1f}"
+
+
+def render_trace(trace: dict) -> str:
+    """One trace's ASCII waterfall + phase percentages.
+
+    ``trace`` is the /debug/traces/{id} document (trace_id, meta, root).
+    Spans with no end (request still in flight / producer died) render to
+    the trace's horizon with a ``…`` marker.
+    """
+    root = trace["root"]
+    t0 = root["start"]
+    horizon = root.get("end") or max(
+        (s.get("end") or s["start"] for s, _ in _walk(root)), default=t0)
+    total = max(horizon - t0, 1e-9)
+
+    lines = []
+    meta = trace.get("meta") or {}
+    head = [f"trace {trace.get('trace_id', '?')}"]
+    for k in ("route", "engine", "lane", "status"):
+        if meta.get(k) is not None:
+            head.append(f"{k}={meta[k]}")
+    lines.append("  ".join(head))
+    lines.append(f"total {total * 1000.0:.1f} ms"
+                 + ("" if root.get("end") else "  (in flight)"))
+    lines.append("")
+
+    #: name | start-ms | dur-ms | timeline bar
+    phase_seconds: dict[str, float] = {}
+    for span, depth in _walk(root):
+        start = span["start"] - t0
+        end = (span.get("end") or horizon) - t0
+        dur = max(end - start, 0.0)
+        open_marker = "" if span.get("end") else "…"
+        if depth == 1:      # direct children of the root ARE the phases
+            phase_seconds[span["name"]] = (
+                phase_seconds.get(span["name"], 0.0) + dur)
+        lo = min(int(start / total * WIDTH), WIDTH - 1)
+        hi = max(min(int(end / total * WIDTH + 0.999), WIDTH), lo + 1)
+        bar = " " * lo + "█" * (hi - lo) + " " * (WIDTH - hi)
+        name = (" " * (depth * INDENT) + span["name"])[:NAME_COL]
+        extra = ""
+        if span.get("attrs", {}).get("tokens") is not None:
+            extra = f"  t={span['attrs']['tokens']}"
+        lines.append(f"{name:<{NAME_COL}} {_fmt_ms(start)} "
+                     f"{_fmt_ms(dur)} |{bar}|{open_marker}{extra}")
+        for ev in span.get("events", ()):
+            at = ev["at"] - t0
+            mark = min(int(at / total * WIDTH), WIDTH - 1)
+            tick = " " * mark + "▲" + " " * (WIDTH - mark - 1)
+            ename = (" " * ((depth + 1) * INDENT) + "* " + ev["name"])[:NAME_COL]
+            lines.append(f"{ename:<{NAME_COL}} {_fmt_ms(at)} {'':>6} |{tick}|")
+
+    if phase_seconds:
+        lines.append("")
+        lines.append("phase breakdown:")
+        accounted = 0.0
+        for name, dur in sorted(phase_seconds.items(), key=lambda kv: -kv[1]):
+            accounted += dur
+            lines.append(f"  {name:<20} {dur * 1000.0:8.1f} ms "
+                         f"{dur / total * 100.0:5.1f}%")
+        other = max(total - accounted, 0.0)
+        lines.append(f"  {'(unattributed)':<20} {other * 1000.0:8.1f} ms "
+                     f"{other / total * 100.0:5.1f}%")
+    return "\n".join(lines)
+
+
+def render_listing(doc: dict) -> str:
+    """The /debug/traces summary table (newest first)."""
+    rows = [f"{'trace_id':<34} {'route':<20} {'ms':>8}  spans"]
+    for s in doc.get("traces", ()):
+        dur = s.get("duration_s")
+        rows.append(
+            f"{s['trace_id']:<34} "
+            f"{str((s.get('meta') or {}).get('route', '?')):<20} "
+            f"{dur * 1000.0 if dur is not None else -1.0:8.1f}  "
+            f"{s.get('spans', '?')}")
+    return "\n".join(rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="trace_report")
+    ap.add_argument("--url", help="server base URL (http://host:port)")
+    ap.add_argument("--trace", help="trace id to render")
+    ap.add_argument("--file", help="saved /debug/traces[/{id}] JSON")
+    args = ap.parse_args(argv)
+
+    if args.file:
+        doc = json.load(open(args.file, encoding="utf-8"))
+    elif args.url:
+        base = args.url.rstrip("/")
+        if args.trace:
+            doc = _fetch(f"{base}/debug/traces/{args.trace}")
+        else:
+            doc = _fetch(f"{base}/debug/traces")
+    else:
+        ap.error("one of --url or --file is required")
+        return 2
+
+    if "root" in doc:                       # a single trace document
+        print(render_trace(doc))
+        return 0
+    print(render_listing(doc))
+    traces = doc.get("traces") or []
+    if traces:
+        slowest = max(traces,
+                      key=lambda s: s.get("duration_s") or -1.0)
+        if args.url and slowest.get("duration_s") is not None:
+            print()
+            print("slowest completed request:")
+            print(render_trace(_fetch(
+                f"{args.url.rstrip('/')}/debug/traces/"
+                f"{slowest['trace_id']}")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
