@@ -139,10 +139,11 @@ def synth_params_device(cfg, seed: int = 0, fmt: str = "int8") -> dict:
     (~0.63 B/w) — mirroring coldstart_main's file writer (the repo's
     file-fidelity definition).  ``fmt="q5km"``: the Q5_K_M analogue —
     the same Q6_K tensors plus fused Q5_K for the rest (~0.75 B/w split /
-    ~1.125 B/w under the default ``pre`` layout).  Slightly conservative vs a genuine
-    llama.cpp artifact, whose ``use_more_bits`` recipe puts only about
-    half the ffn_down layers on Q6_K (~5% fewer HBM bytes/token than this
-    grid); a real Q4_K_M file (reference api.py:14) serves at or above
+    ~1.125 B/w under the default ``pre`` layout).  Slightly conservative
+    vs a genuine llama.cpp artifact, whose ``use_more_bits`` recipe puts
+    only about half the ffn_down layers on Q6_K (~5% fewer HBM
+    bytes/token than this grid); a real Q4_K_M file (reference
+    api.py:14) serves at or above
     the number this grid reports.  Decode bandwidth is value-independent,
     so these measure exactly what real quantized weights would.
     """
@@ -436,11 +437,179 @@ def write_coldstart_file(path: str) -> None:
     w.write()
 
 
+def ttft_sweep_main() -> None:
+    """``python bench.py --ttft-sweep`` (env: LFKT_BENCH_TTFT_SWEEP=1):
+    the long-context TTFT grid — context ladder × prefill-chunk sweep —
+    emitting ONE JSON line per point so a round can bank the whole
+    TTFT-vs-context curve as an artifact (round-6 targets: 8k < 500 ms,
+    32k < 2.5 s).
+
+    Axes (env-tunable): LFKT_BENCH_TTFT_CTXS (default
+    ``2048,8192,16384,32768``) × LFKT_BENCH_TTFT_CHUNKS (default
+    ``0,512,1024,2048``; 0 = monolithic bucket prefill).  Each chunked
+    point runs the engine's double-buffered slice walk — the same
+    prefill_chunk_jit programs and overlap bound Engine._prefill_padded
+    serves with (LFKT_PREFILL_OVERLAP), so a point IS the serving
+    configuration, not a proxy.  The flash kernel's fused-KV-block size
+    rides LFKT_FLASH_KV_UNROLL (one value per process: it is baked into
+    the compiled programs) and is stamped on every line.
+    """
+    import dataclasses
+    from collections import deque
+
+    import jax
+    import jax.numpy as jnp
+
+    from llama_fastapi_k8s_gpu_tpu.utils.config import (
+        force_cpu_if_requested,
+        knob,
+    )
+
+    force_cpu_if_requested()
+
+    from llama_fastapi_k8s_gpu_tpu.utils.jaxcache import setup_compile_cache
+
+    if jax.default_backend() != "cpu":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        cache_dir = os.environ.setdefault(
+            "LFKT_COMPILE_CACHE_DIR", os.path.join(repo, ".lfkt_xla_cache"))
+        maybe_seed_compile_cache(repo, cache_dir)
+    setup_compile_cache()
+
+    from llama_fastapi_k8s_gpu_tpu.models.config import LLAMA3_8B, ModelConfig
+    from llama_fastapi_k8s_gpu_tpu.models.generate import (
+        prefill_chunk_jit,
+        prefill_jit,
+        sample_jit,
+    )
+    from llama_fastapi_k8s_gpu_tpu.models.llama import init_cache
+    from llama_fastapi_k8s_gpu_tpu.ops.pallas.probe import (
+        probe_flash_attention,
+    )
+    from llama_fastapi_k8s_gpu_tpu.sampling.sample import (
+        SamplingParams,
+        sampling_tensors,
+        seed_window,
+    )
+
+    preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
+    wfmt = os.environ.get("LFKT_BENCH_FMT", "q4km")
+    tiny = preset == "tiny"
+    if tiny:
+        cfg0 = ModelConfig(vocab_size=512, dim=128, n_layers=2, n_heads=8,
+                           n_kv_heads=4, ffn_dim=256, n_ctx=256)
+        ctxs_def, chunks_def, attn_def = "64,128", "0,16", "xla"
+    else:
+        cfg0 = LLAMA3_8B
+        ctxs_def, chunks_def, attn_def = \
+            "2048,8192,16384,32768", "0,512,1024,2048", "pallas"
+    ctxs = [int(c) for c in os.environ.get(
+        "LFKT_BENCH_TTFT_CTXS", ctxs_def).split(",") if c]
+    chunks = [int(c) for c in os.environ.get(
+        "LFKT_BENCH_TTFT_CHUNKS", chunks_def).split(",") if c != ""]
+    attn = os.environ.get("LFKT_BENCH_ATTN", attn_def)
+    kv_dtype = os.environ.get("LFKT_KV_DTYPE", "bf16")
+    overlap = int(knob("LFKT_PREFILL_OVERLAP"))
+    kv_unroll = int(knob("LFKT_FLASH_KV_UNROLL"))
+
+    dev = jax.devices()[0]
+    print(f"{_INIT_MARK} {dev}", file=sys.stderr, flush=True)
+
+    fallbacks = {}
+    wfmt, reason = probe_fused_or_degrade(wfmt, "ttft-sweep")
+    if reason is not None:
+        fallbacks["fmt_fallback"] = reason
+    if attn == "pallas":
+        err = probe_flash_attention(quantized=kv_dtype == "int8")
+        if err is not None:
+            fallbacks["attn_fallback"] = f"flash attention: {err}"[:300]
+            attn = "xla"
+
+    params = synth_params_device(dataclasses.replace(cfg0, n_ctx=ctxs[0]),
+                                 fmt=wfmt)
+    fused_key = FUSED_KEYS.get(wfmt)
+    if fused_key is not None and not any(
+            isinstance(v, dict) and any(fk in v for fk in fused_key)
+            for v in [*params["layers"].values(), params["output"]]):
+        wfmt = "int8"
+    sp = SamplingParams()
+    st = sampling_tensors(sp)
+
+    def one_ttft(cfg, prompt_len: int, chunk: int) -> float:
+        """One prompt → first sampled token, seconds.  chunk=0: monolithic
+        prefill_jit at the bucket; chunk>0: the engine's overlapped slice
+        walk (zero-copy host views, async dispatch, depth-bounded)."""
+        import numpy as np
+
+        prompt = np.arange(1, prompt_len + 1, dtype=np.int32)
+        cache = init_cache(cfg)
+        t0 = time.time()
+        if chunk <= 0:
+            logits, cache = prefill_jit(
+                params, cfg, jnp.asarray(prompt), jnp.int32(prompt_len),
+                cache)
+        else:
+            logits = None
+            inflight = deque()
+            off = 0
+            while off < prompt_len:
+                n = min(chunk, prompt_len - off)
+                lg, cache = prefill_chunk_jit(
+                    params, cfg, jnp.asarray(prompt[off:off + n]),
+                    jnp.int32(off), jnp.int32(n - 1), cache)
+                logits = lg
+                inflight.append(lg)
+                if len(inflight) > overlap:
+                    jax.block_until_ready(inflight.popleft())
+                off += n
+        window, wpos = seed_window(prompt.tolist())
+        tok, *_ = sample_jit(logits, window, wpos, jax.random.PRNGKey(0),
+                             st, cfg)
+        int(tok)  # host fetch: the only reliable sync on the tunneled device
+        return time.time() - t0
+
+    for n_ctx in ctxs:
+        cfg = dataclasses.replace(cfg0, n_ctx=n_ctx, attn_impl=attn,
+                                  kv_dtype=kv_dtype)
+        # half-context prompts, the convention of the existing 8k/16k/32k
+        # PERF ladder (bench_8k/16k/32k_2026-08-01 artifacts)
+        prompt_len = n_ctx // 2
+        for chunk in chunks:
+            if chunk > prompt_len:
+                continue                  # one slice == monolithic: skip dup
+            one_ttft(cfg, prompt_len, chunk)   # compile
+            samples = sorted(one_ttft(cfg, prompt_len, chunk)
+                             for _ in range(5))
+            ms = samples[len(samples) // 2] * 1000.0
+            kv_tag = "" if kv_dtype == "bf16" else f",kv-{kv_dtype}"
+            line = {
+                "metric": (f"ttft_ms_p50[ttft-sweep,{preset},{wfmt}{kv_tag}"
+                           f",ctx{n_ctx},"
+                           f"{'mono' if chunk <= 0 else f'chunk{chunk}'}]"),
+                "value": round(ms, 1),
+                "unit": "ms",
+                "vs_baseline": 0.0,   # informational grid; no A10G analogue
+                "n_ctx": n_ctx,
+                "prompt_tokens": prompt_len,
+                "prefill_chunk": chunk,
+                "prefill_overlap": overlap,
+                "attn_impl": attn,
+                "kv_unroll": kv_unroll,
+                "samples_ms": [round(s * 1000.0, 1) for s in samples],
+                "device": str(dev),
+            }
+            line.update(fallbacks)
+            print(json.dumps(line), flush=True)
+
+
 def child_main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
     if os.environ.get("LFKT_BENCH_COLDSTART") == "1":
         coldstart_main()
+        return
+    if os.environ.get("LFKT_BENCH_TTFT_SWEEP") == "1":
+        ttft_sweep_main()
         return
 
     import jax
@@ -777,13 +946,26 @@ def _run_attempt(init_timeout: float, total_timeout: float):
         time.sleep(0.5)
     th_o.join(timeout=5); th_e.join(timeout=5)
 
-    for line in reversed(stdout_lines):
+    metric_lines = []
+    for line in stdout_lines:
         try:
             parsed = json.loads(line)
             if isinstance(parsed, dict) and "metric" in parsed:
-                return line, None, True
+                metric_lines.append(line)
         except ValueError:
             continue
+    if metric_lines and err is None and proc.poll() == 0:
+        # multi-point modes (--ttft-sweep) emit one line per grid point;
+        # the single-metric modes emit exactly one — forward them all.
+        # Success requires a CLEAN exit: a sweep child killed mid-grid
+        # (timeout, OOM at the 32k point) has printed a silently partial
+        # grid, and banking it as complete would drop exactly the rows
+        # the round targets — retry/fail instead.
+        return metric_lines, None, True
+    if metric_lines:
+        cause = err or f"rc={proc.poll()}"
+        err = (f"child emitted {len(metric_lines)} metric line(s) but did "
+               f"not finish cleanly ({cause}); discarding the partial grid")
     if err is None:
         tail = " | ".join(stderr_tail[-6:])[-600:]
         err = f"child exited rc={proc.poll()} without a result: {tail}"
@@ -799,6 +981,9 @@ def _run_attempt(init_timeout: float, total_timeout: float):
 
 
 def main() -> None:
+    if "--ttft-sweep" in sys.argv[1:]:
+        # flag → env so the watchdog-spawned child (argument-less) sees it
+        os.environ["LFKT_BENCH_TTFT_SWEEP"] = "1"
     if os.environ.get("LFKT_BENCH_CHILD") == "1":
         child_main()
         return
@@ -838,10 +1023,11 @@ def main() -> None:
         if remaining < 60:
             errors.append(f"overall deadline reached after {i} attempt(s)")
             break
-        line, err, retriable = _run_attempt(
+        lines, err, retriable = _run_attempt(
             min(init_timeout, remaining), min(total_timeout, remaining))
-        if line is not None:
-            print(line, flush=True)
+        if lines is not None:
+            for line in lines:
+                print(line, flush=True)
             return
         errors.append(err or "unknown error")
         if not retriable:
@@ -849,10 +1035,12 @@ def main() -> None:
 
     preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
     wfmt = os.environ.get("LFKT_BENCH_FMT", "q4km")
+    sweep = os.environ.get("LFKT_BENCH_TTFT_SWEEP") == "1"
     print(json.dumps({
-        "metric": f"decode_tokens_per_sec_per_chip[{preset},{wfmt},synthetic]",
+        "metric": (f"ttft_ms_p50[ttft-sweep,{preset},{wfmt}]" if sweep else
+                   f"decode_tokens_per_sec_per_chip[{preset},{wfmt},synthetic]"),
         "value": 0.0,
-        "unit": "tokens/sec/chip",
+        "unit": "ms" if sweep else "tokens/sec/chip",
         "vs_baseline": 0.0,
         "error": f"{len(errors)} attempt(s) failed; last: {errors[-1][:500]}",
         "attempts": len(errors),

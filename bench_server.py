@@ -130,6 +130,13 @@ def main() -> None:
             # twice (the round-4 lane-prefix lesson).
             decode_chunk=settings.decode_chunk,
             adm_budget=settings.adm_budget,
+            # the round-6 prefill-pipeline A/B axes: EMA admission
+            # controller vs static budget (LFKT_ADM_CONTROLLER) and the
+            # overlapped-prefill depth — both labeled on the metric so an
+            # env A/B can never measure the same arm twice
+            adm_controller=settings.adm_controller,
+            adm_ema_alpha=settings.adm_ema_alpha,
+            prefill_overlap=settings.prefill_overlap,
             spec_decode=spec_decode, spec_draft=spec_draft,
             # the lane-prefix A/B knobs (VERDICT r4 #8).  The admission
             # slice size matters to the A/B too: reuse is chunk-aligned,
@@ -155,7 +162,9 @@ def main() -> None:
                                 decode_chunk=settings.decode_chunk,
                                 spec_decode=spec_decode,
                                 spec_draft=spec_draft,
-                                prefix_cache=multiturn)
+                                prefix_cache=multiturn,
+                                prefill_chunk=settings.prefill_chunk,
+                                prefill_overlap=settings.prefill_overlap)
     # compile every shape BEFORE the server phase, exactly like the
     # production factory (server/app.py calls eng.warmup() at startup);
     # without it the first request compiles for ~60 s and the 25 s
@@ -573,6 +582,8 @@ def main() -> None:
                    + (",fullctx" if fullctx else "")
                    + (",spec" if spec_decode == "lookup" else "")
                    + (",laneprefix" if lane_prefix and batch > 1 else "")
+                   + (",admstatic" if batch > 1
+                      and not settings.adm_controller else "")
                    + (f",chunk{settings.decode_chunk}"
                       if settings.decode_chunk != Settings.decode_chunk
                       else "")
@@ -604,6 +615,11 @@ def main() -> None:
         "batch_size": batch,
         "device": str(dev),
     }
+    if batch > 1:
+        # admission-controller telemetry for the prefill-heavy agg A/B:
+        # live budget + EMAs say WHY an arm's agg_tok_s moved
+        result["scheduler_stats"] = eng.scheduler_stats()
+        result["adm_controller"] = settings.adm_controller
     if spec_decode == "lookup":
         # acceptance telemetry: accepted/drafted is THE pays-or-not number
         if batch > 1:

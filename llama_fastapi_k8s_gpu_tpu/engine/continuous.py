@@ -82,6 +82,90 @@ def _lane_cache_copy_jit(cache: dict, lane) -> dict:
 _STREAM_END = object()   # scheduler→stream-consumer sentinel
 
 
+class AdmissionController:
+    """Derives the scheduler's per-wave admission prefill-token budget from
+    *measured* decode slack instead of the static ``LFKT_ADM_BUDGET``.
+
+    The two signals, both free to measure on the scheduler thread:
+
+    - **lane-idle fraction** — free lanes are lost throughput, so admission
+      (refilling them) is the bottleneck: the budget should rise.
+    - **decode pressure** — the fraction of the wave the scheduler spent
+      *blocked* fetching the previous decode chunk.  A long fetch wait
+      means the device was still busy when the host came back (decode is
+      the bottleneck; prefill slices queued between chunks directly delay
+      live lanes), so the budget should shrink.  A near-zero wait means
+      the device sat idle waiting for the host — those admission slices
+      were free, and more would be too.
+
+    Both are EMA-smoothed (``alpha`` = LFKT_ADM_EMA_ALPHA; the EMAs SEED
+    from the first observation, so the controller acts on measured state
+    from wave one instead of riding an optimistic prior) and drive an
+    AIMD update with the cut taking priority: sustained pressure halves
+    the budget even while lanes sit idle (idle lanes under decode
+    saturation mean decode can't keep up — feeding it more prefill is
+    exactly the round-5 interference); otherwise idle lanes or plentiful
+    slack grow it by one slice.  The floor is ONE slice per wave — an
+    admission (deadline-bearing or not) always makes progress, so the
+    controller can throttle but never starve (pinned by
+    tests/test_admission.py).  Single-threaded by design: owned and
+    driven by the scheduler loop.
+    """
+
+    #: ema_pressure below this means the device had idle headroom → grow
+    SLACK_PRESSURE = 0.25
+    #: ema_pressure above this means decode waits on the host's wave → cut
+    HIGH_PRESSURE = 0.5
+
+    def __init__(self, chunk: int, lanes: int, base: int,
+                 alpha: float = 0.25, max_factor: int = 8):
+        self.chunk = max(1, int(chunk))
+        self.lanes = max(1, int(lanes))
+        self.alpha = min(1.0, max(0.01, float(alpha)))
+        self.min_budget = self.chunk              # ≥ one slice: no starvation
+        self.max_budget = max(int(base), self.chunk) * max(1, int(max_factor))
+        self.budget = min(max(int(base), self.min_budget), self.max_budget)
+        self.ema_idle = 0.0       # seeded from the first observation
+        self.ema_pressure = 0.0
+        self.waves = 0
+
+    def observe_wave(self, lanes_live: int, fetch_wait_s: float,
+                     wave_s: float) -> int:
+        """Fold one scheduler wave's measurements in; returns the budget
+        for the NEXT wave."""
+        a = self.alpha
+        idle = 1.0 - min(lanes_live, self.lanes) / self.lanes
+        pressure = min(1.0, fetch_wait_s / wave_s) if wave_s > 0 else 0.0
+        if self.waves == 0:
+            # seed, don't smooth: a controller born into saturation must
+            # not spend ~1/alpha waves growing on an optimistic prior
+            # (that ride IS the interference it exists to close, and the
+            # watchdog-recovery path deliberately re-creates controllers
+            # under live load)
+            self.ema_idle, self.ema_pressure = idle, pressure
+        else:
+            self.ema_idle += a * (idle - self.ema_idle)
+            self.ema_pressure += a * (pressure - self.ema_pressure)
+        self.waves += 1
+        if self.ema_pressure > self.HIGH_PRESSURE:
+            # decode saturates the device: halve, floor at one slice.
+            # Takes PRIORITY over idle — free lanes under saturation mean
+            # decode can't keep up, and more prefill only starves it.
+            self.budget = max(self.budget // 2, self.min_budget)
+        elif self.ema_idle > 0.01 or self.ema_pressure < self.SLACK_PRESSURE:
+            # lanes idle (admission-bound) or decode slack to burn: grow
+            self.budget = min(self.budget + self.chunk, self.max_budget)
+        return self.budget
+
+    def stats(self) -> dict:
+        """Point-in-time introspection for scheduler_stats()/metrics."""
+        return {
+            "adm_budget_tokens": self.budget,
+            "adm_ema_idle": round(self.ema_idle, 4),
+            "adm_ema_pressure": round(self.ema_pressure, 4),
+        }
+
+
 class _Item:
     """One queued request: a future (non-stream) OR a chunk sink (stream)."""
     __slots__ = ("future", "messages", "sp", "max_tokens", "stops", "seed",
@@ -172,6 +256,7 @@ class ContinuousEngine(MeshEngine):
     _THREAD_CONFINED = (
         "_bstate", "_lane_st", "_scratch_cache", "_adm", "_lane_claims",
         "_prefix_stats", "_spec_stats", "_stats", "_loop_error",
+        "_adm_budget", "_lane_idle_s",
     )
     # cross-thread by design; individual operations are GIL-atomic
     # (dict/Queue/Event ops) or single reference stores
@@ -180,19 +265,29 @@ class ContinuousEngine(MeshEngine):
 
     def __init__(self, model_path: str | None, *, max_top_k: int = 64,
                  prefill_chunk: int = 256, adm_budget: int = 512,
-                 lane_prefix_cache: bool = False, **kw):
-        super().__init__(model_path, **kw)
-        #: admission prompt-slice size: smaller → tighter bound on how long
-        #: live lanes' decode waits behind an admission's device work
-        self._prefill_chunk = max(1, prefill_chunk)
-        #: prefill-token budget per scheduler iteration: with short prompts
-        #: several COMPLETE admissions fit one iteration (round-3 limit was
-        #: exactly one, which left freed lanes idle under churn — lanes
-        #: drain at up to B/n_chunks per iteration but refill at 1); a
-        #: long prompt still yields after one slice (bounded decode stall)
+                 adm_controller: bool = True, adm_ema_alpha: float = 0.25,
+                 lane_prefix_cache: bool = True, **kw):
+        # the admission prompt-slice size doubles as the serial overlapped-
+        # prefill slice size, so it lives on Engine (self._prefill_chunk)
+        super().__init__(model_path, prefill_chunk=prefill_chunk, **kw)
+        #: prefill-token budget per scheduler wave.  Static when the
+        #: admission controller is off (LFKT_ADM_CONTROLLER=0): with short
+        #: prompts several COMPLETE admissions fit one wave, and a long
+        #: prompt consumes the budget in slices.  With the controller on
+        #: (the default) this value is rewritten every wave from the EMA of
+        #: measured lane-idle/decode-slack — see AdmissionController.
         self._adm_budget = max(self._prefill_chunk, adm_budget)
+        self._adm_base = self._adm_budget      # controller re-init (recover)
+        self._adm_alpha = adm_ema_alpha
+        self._adm_ctl = AdmissionController(
+            self._prefill_chunk, self.batch_size, self._adm_budget,
+            alpha=adm_ema_alpha) if adm_controller else None
+        #: cumulative idle lane-seconds (free lanes × wave wall), exported
+        #: as scheduler_lane_idle_seconds / the lane_idle_seconds gauge
+        self._lane_idle_s = 0.0
         self._adm: dict | None = None   # in-flight chunked admission
-        # -- lane-prefix reuse (off by default; LFKT_LANE_PREFIX_CACHE) ----
+        # -- lane-prefix reuse (default ON since round 6; the admission
+        # -- controller closed the interference gap that kept it off) ------
         # A freed lane's KV ring still holds its finished conversation;
         # when the next admission's prompt shares that history (multi-turn
         # chat re-sends it verbatim, reference api.py:44-63), the claim is
@@ -409,6 +504,16 @@ class ContinuousEngine(MeshEngine):
         self._adm = None
         self._items.clear()
         self._lane_claims = [None] * self.batch_size
+        self._lane_idle_s = 0.0
+        if self._adm_ctl is not None:
+            # fresh controller: post-recovery traffic should not inherit
+            # the pre-crash EMAs (a wedged device reads as max pressure)
+            self._adm_ctl = AdmissionController(
+                self._prefill_chunk, self.batch_size, self._adm_base,
+                alpha=self._adm_alpha)
+            self._adm_budget = self._adm_ctl.budget
+        else:
+            self._adm_budget = self._adm_base
         self._stats = {"lanes_live": 0, "pending": 0, "admission_inflight": 0}
         self.heartbeat.reset()
         self._thread = threading.Thread(
@@ -602,10 +707,16 @@ class ContinuousEngine(MeshEngine):
                 # mid-prefill (or failing later) must not inflate /metrics
             if pspan is not None:
                 pspan.set(n_prompt=len(ids), bucket=bucket, reused=reuse)
+            # host-side slice prep happens ONCE, here, while lanes decode:
+            # one int32 array for the padded prompt; every slice dispatch
+            # then takes a zero-copy view instead of re-converting a list
+            # (the round-6 overlap of slice prep with device compute)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:len(ids)] = ids
             return {
                 "item": item, "ids": ids, "n_prompt": len(ids),
                 "bucket": bucket,
-                "padded": ids + [0] * (bucket - len(ids)),
+                "padded": padded,
                 "st": sampling_tensors(item.sp),
                 "seed": item.seed if item.seed is not None else self._next_seed(),
                 "t0": t0, "offset": reuse, "reused": reuse, "logits": None,
@@ -621,7 +732,15 @@ class ContinuousEngine(MeshEngine):
 
     def _dispatch_prefill_chunk(self, adm: dict) -> None:
         """Run ONE prompt slice through the model into the scratch cache.
-        Keeps the logits of the slice containing the last real token."""
+        Keeps the logits of the slice containing the last real token.
+
+        The dispatch is async — its host wall (observed into the
+        ``prefill_slice_seconds`` histogram and the span's per-slice
+        event) is slice prep + device enqueue, overlapping the previous
+        slice's / decode chunk's compute; a long wall here means the
+        device queue pushed back (the interference signal the admission
+        controller is closing)."""
+        t_s = time.time()
         self.heartbeat.beat()
         FAULTS.fire("prefill")
         if self._scratch_cache is None:
@@ -632,7 +751,7 @@ class ContinuousEngine(MeshEngine):
             self._scratch_cache = init_cache(self.cfg)
         off = adm["offset"]
         C = min(self._prefill_chunk, adm["bucket"] - off)
-        sl = jnp.asarray(adm["padded"][off:off + C], jnp.int32)
+        sl = jnp.asarray(adm["padded"][off:off + C])
         li = min(max(adm["n_prompt"] - 1 - off, 0), C - 1)
         logits, cache = prefill_chunk_jit(
             self.params, self.cfg, sl, jnp.int32(off), jnp.int32(li),
@@ -641,8 +760,11 @@ class ContinuousEngine(MeshEngine):
         if off <= adm["n_prompt"] - 1 < off + C:
             adm["logits"] = logits
         adm["offset"] = off + C
+        dt = time.time() - t_s
+        self._observe_slice(dt)
         if adm.get("span") is not None:
-            adm["span"].event("prefill_slice", offset=off, tokens=C)
+            adm["span"].event("prefill_slice", offset=off, tokens=C,
+                              host_s=round(dt, 6))
 
     def _finish_admission(self, adm: dict, lane: int, slots: list) -> None:
         """Prefill complete: sample the first token, write the lane, install.
@@ -918,13 +1040,23 @@ class ContinuousEngine(MeshEngine):
         return adm["offset"] - off_before
 
     def _admit_round(self, slots: list) -> bool:
-        """Admissions for ONE scheduler iteration: complete admissions are
-        taken until the per-iteration prefill-token budget runs out, a
-        partial (long-prompt) admission yields, or lanes/queue are
-        exhausted.  At most one admission is ever mid-prompt, so prefill
-        slices of different requests never interleave on the device queue
-        and the single scratch cache stays safe: a completed admission's
-        lane write is dispatched BEFORE the next admission's first slice.
+        """Admissions for ONE scheduler wave: admission progress — complete
+        short admissions AND successive slices of one long prompt — is
+        taken until the per-wave prefill-token budget runs out or the
+        lanes/queue are exhausted.  At most one admission is ever
+        mid-prompt, so prefill slices of different requests never
+        interleave on the device queue and the single scratch cache stays
+        safe: a completed admission's lane write is dispatched BEFORE the
+        next admission's first slice.  With the admission controller ON a
+        long prompt advances by up to ``budget`` tokens per wave (round 5
+        advanced exactly one slice per wave regardless of budget, which
+        put a 32k admission ~128 decode waves away from its first token);
+        the controller shrinks the budget back toward one slice when that
+        interleaving pressures live lanes' decode.  With the controller
+        OFF (LFKT_ADM_CONTROLLER=0) a mid-prompt admission still yields
+        after ONE slice — the static mode IS the pre-round-6 behavior,
+        so it stays a valid A/B control arm (nothing then adapts the
+        budget down if a big static number turned out to stall decode).
         Returns True if any progress was made."""
         budget = self._adm_budget
         progressed = False
@@ -934,16 +1066,17 @@ class ContinuousEngine(MeshEngine):
                 break
             progressed = True
             budget -= spent
-            if self._adm is not None:
-                break   # long admission yielded mid-prompt: bounded stall
+            if self._adm is not None and self._adm_ctl is None:
+                break   # static mode: long admission yields after one slice
         return progressed
 
     def scheduler_stats(self) -> dict:
         """Point-in-time scheduler occupancy for ``/metrics`` (lanes_live,
-        pending queue depth, whether an admission prefill is in flight) —
-        the observability the lane model adds over the reference's single
-        queue-depth number.  Written once per loop iteration; reads are a
-        dict swap, no lock needed."""
+        pending queue depth, whether an admission prefill is in flight,
+        the live admission budget and its controller EMAs, cumulative
+        lane-idle seconds) — the observability the lane model adds over
+        the reference's single queue-depth number.  Written once per loop
+        iteration; reads are a dict swap, no lock needed."""
         out = {"batch_size": self.batch_size, **self._stats}
         if self._lane_prefix:
             out.update(self._prefix_stats)
@@ -1089,6 +1222,7 @@ class ContinuousEngine(MeshEngine):
         B = self.batch_size
         slots: list[_Slot | None] = [None] * B
         pending = None   # (lane snapshot, un-fetched device tokens)
+        t_prev_wave = time.time()   # decode-wave clock (controller signals)
         try:
             while not self._stop:
                 if not any(s is not None for s in slots) and pending is None:
@@ -1104,6 +1238,7 @@ class ContinuousEngine(MeshEngine):
                             self._wake.wait(timeout=0.05)
                             self._wake.clear()
                         continue
+                    t_prev_wave = time.time()   # lanes just filled: new wave
 
                 # ---- one decode chunk for every live lane (per-lane sampling
                 # knobs incl. traced top_k ride in self._lane_st; the static
@@ -1156,15 +1291,40 @@ class ContinuousEngine(MeshEngine):
 
                 # ---- harvest the PREVIOUS chunk (fetch blocks only until
                 # that chunk is done; the one dispatched above keeps the
-                # device busy meanwhile) -----------------------------------
+                # device busy meanwhile).  The fetch's blocking time IS the
+                # decode-pressure signal: a long wait means the device was
+                # still decoding when the host came back (admission slices
+                # queued this wave delay the NEXT chunk, surfacing here one
+                # wave later); a near-zero wait means the device sat idle —
+                # the admission controller converts that slack into budget.
+                fetch_wait = 0.0
                 if pending is not None:
-                    self._harvest(pending[0], np.asarray(pending[1]), slots)
+                    t_f = time.time()
+                    chunk_np = np.asarray(pending[1])
+                    fetch_wait = time.time() - t_f
+                    self._harvest(pending[0], chunk_np, slots)
+                now = time.time()
+                wave_s = max(now - t_prev_wave, 0.0)
+                t_prev_wave = now
+                if dispatched is not None:
+                    live_wave = sum(s is not None for s in dispatched[0])
+                    # idle lane-seconds: free lanes while others decode are
+                    # lost throughput (the admission controller's raw signal)
+                    self._lane_idle_s += (B - live_wave) * wave_s
+                    if self._adm_ctl is not None:
+                        self._adm_budget = self._adm_ctl.observe_wave(
+                            live_wave, fetch_wait, wave_s)
                 pending = dispatched
-                self._stats = {
+                stats = {
                     "lanes_live": sum(s is not None for s in slots),
                     "pending": self._pending.qsize(),
                     "admission_inflight": int(self._adm is not None),
+                    "adm_budget_tokens": self._adm_budget,
+                    "lane_idle_seconds": round(self._lane_idle_s, 3),
                 }
+                if self._adm_ctl is not None:
+                    stats.update(self._adm_ctl.stats())
+                self._stats = stats
                 # watchdog pulse: a beat per loop iteration, busy = queued +
                 # occupied work.  A loop wedged inside a device call stops
                 # beating with busy > 0 — the stall signature.

@@ -23,6 +23,7 @@ import logging
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Iterator, Sequence
 
 import jax
@@ -129,6 +130,12 @@ class Engine:
         "last_timings": "_id_lock",
     }
 
+    #: sliced bucket prefill (prefill_chunk/prefill_overlap) runs the ring
+    #: through prefill_chunk_jit, which assumes an UNSHARDED n_ctx dim —
+    #: the sequence-parallel engine (engine/sp.py) overrides this to False
+    #: and keeps its rerouted monolithic ring prefill.
+    _SLICE_PREFILL = True
+
     def __init__(
         self,
         model_path: str | None,
@@ -147,6 +154,11 @@ class Engine:
         spec_draft: int = 8,
         prefix_cache: bool = True,  # reuse the previous request's KV prefix
         prefix_min: int = 32,       # shortest common prefix worth reusing
+        prefill_chunk: int = 256,   # prefill slice size: the continuous
+        #                             scheduler's admission slices AND the
+        #                             serial overlapped bucket slices
+        prefill_overlap: int = 2,   # un-synced prefill slices in flight
+        #                             (0 = monolithic bucket prefill)
         *,
         _parts: tuple | None = None,  # (params, cfg, tokenizer, template_kind)
     ):
@@ -154,6 +166,14 @@ class Engine:
         self.n_ctx = n_ctx
         self.decode_chunk = decode_chunk
         self.max_gen_tokens = max_gen_tokens
+        #: prefill slice size shared by the serial overlapped path and the
+        #: continuous scheduler's chunked admission (engine/continuous.py)
+        self._prefill_chunk = max(1, int(prefill_chunk))
+        self._prefill_overlap = max(0, int(prefill_overlap))
+        #: optional utils.metrics.Metrics the server injects after
+        #: construction (server/app.py) — engines observe prefill-slice
+        #: timings into it; None (tests, benches, library use) is free
+        self.metrics_sink = None
         #: progress pulse for the engine watchdog (engine/watchdog.py):
         #: one beat per device step, busy brackets around generations,
         #: an error ring for burst detection.  Engines never import the
@@ -433,11 +453,11 @@ class Engine:
         with self._lock:   # uncontended at warmup; the ring-write invariant
             #                (writes to _cache only under _lock) stays intact
             for b in self.prefill_buckets[1:]:
-                ids = [0] * (b - 1)
-                cache = self._cache
-                logits, cache = self._prefill_call(
-                    jnp.asarray(ids + [0], jnp.int32)[:b], jnp.int32(len(ids)),
-                    cache)
+                # compile the program(s) this bucket actually serves with:
+                # monolithic prefill for small buckets, the slice walk for
+                # buckets the overlapped path slices (_slices_prefill)
+                logits, cache = self._prefill_padded(
+                    [0] * (b - 1), b - 1, b, self._cache)
                 jax.block_until_ready(logits)
                 self._cache = cache
             if self._prefix_cache:
@@ -460,6 +480,76 @@ class Engine:
     # runs them sequence-parallel; the vmap/batched engines bypass them) ----
     def _prefill_call(self, tokens, length, cache):
         return prefill_jit(self.params, self.cfg, tokens, length, cache)
+
+    def _slices_prefill(self, bucket: int) -> bool:
+        """Whether a ``bucket``-sized prompt prefills as overlapped slices
+        (vs one monolithic program).  Buckets at or under the slice size
+        gain nothing from slicing and keep the single-program path."""
+        return (self._SLICE_PREFILL and self._prefill_overlap > 0
+                and bucket > self._prefill_chunk)
+
+    def _observe_slice(self, dt: float) -> None:
+        """Feed one prefill-slice host wall time into the server's metrics
+        (``prefill_slice_seconds``); free when no sink is installed."""
+        m = self.metrics_sink
+        if m is not None:
+            try:
+                m.observe("prefill_slice_seconds", dt)
+            except Exception:  # noqa: BLE001 — telemetry must never fail serving
+                pass
+
+    def _prefill_padded(self, ids: list, n_prompt: int, bucket: int,
+                        cache, pspan=None):  # lfkt: holds[_lock]
+        """Bucket prefill, monolithic or sliced: returns (logits, cache).
+
+        The sliced path is the round-6 double-buffered pipeline: the padded
+        prompt is prepared ONCE as a host int32 array, then each slice is a
+        zero-copy view dispatched through ``prefill_chunk_jit`` — slice
+        ``i+1``'s host prep (view + device enqueue) overlaps slice ``i``'s
+        device compute because dispatch is async.  ``prefill_overlap``
+        bounds the un-synced slices in flight (the oldest slice's logits
+        are blocked on past the bound) so a 32k prompt cannot queue
+        hundreds of slices on a tunneled device.  Slicing stops at the
+        slice containing the last real token, exactly like the continuous
+        scheduler's admission machine: pure-padding slices would only
+        write cache garbage that is never attended.
+
+        Greedy-bit-identity with the monolithic program is pinned by
+        tests/test_prefill_pipeline.py on every engine flavor.
+        """
+        if not self._slices_prefill(bucket):
+            padded = ids + [0] * (bucket - n_prompt)
+            return self._prefill_call(
+                jnp.asarray(padded, jnp.int32), jnp.int32(n_prompt), cache)
+        C = self._prefill_chunk
+        padded_np = np.zeros((bucket,), np.int32)
+        padded_np[:n_prompt] = ids
+        logits = None
+        inflight: deque = deque()
+        off = 0
+        last = n_prompt - 1
+        while off <= last:
+            t_s = time.time()
+            n = min(C, bucket - off)
+            sl = jnp.asarray(padded_np[off:off + n])
+            li = min(max(last - off, 0), n - 1)
+            lg, cache = prefill_chunk_jit(
+                self.params, self.cfg, sl, jnp.int32(off), jnp.int32(li),
+                cache)
+            if off <= last < off + n:
+                logits = lg
+            inflight.append(lg)
+            if len(inflight) > self._prefill_overlap:
+                # double-buffer bound: wait for the OLDEST slice so at most
+                # `overlap` slices are queued un-synced on the device
+                jax.block_until_ready(inflight.popleft())
+            dt = time.time() - t_s
+            self._observe_slice(dt)
+            if pspan is not None:
+                pspan.event("prefill_slice", offset=off, tokens=n,
+                            host_s=round(dt, 6))
+            off += n
+        return logits, cache
 
     def _decode_chunk_call(self, state, st, n_steps: int, top_k: int):
         return generate_chunk_jit(self.params, self.cfg, state, st,
@@ -632,10 +722,8 @@ class Engine:
                 jnp.asarray(suffix + [0] * (sbucket - s), jnp.int32),
                 jnp.int32(reuse), jnp.int32(s - 1), self._cache)
         else:
-            padded = ids + [0] * (bucket - n_prompt)
-            logits, cache = self._prefill_call(
-                jnp.asarray(padded, jnp.int32), jnp.int32(n_prompt),
-                self._cache)
+            logits, cache = self._prefill_padded(
+                ids, n_prompt, bucket, self._cache, pspan=pspan)
         window, wpos = seed_window(ids)
         key = jax.random.PRNGKey(seed)
         token, window, wpos, key = sample_jit(

@@ -36,6 +36,12 @@ class SPEngine(Engine):
     concurrency model); the mesh is spent on *context length*, not batch.
     """
 
+    #: the overlapped slice-prefill path (Engine._prefill_padded) drives
+    #: prefill_chunk_jit against an unsharded ring; this engine's ring is
+    #: sp-sharded over n_ctx and its prefill is the rerouted ring program
+    #: (sp_prefill), so it keeps monolithic bucket prefill.
+    _SLICE_PREFILL = False
+
     def __init__(self, model_path: str | None, *, sp: int = 2, tp: int = 1,
                  n_ctx: int = 4096, **kw):
         if sp < 2:

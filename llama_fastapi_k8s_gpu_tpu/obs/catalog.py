@@ -102,6 +102,16 @@ METRICS: dict[str, Metric] = _register(
            "requests served with prompt-prefix KV reuse"),
     Metric("prefix_cache_reused_tokens_total", COUNTER,
            "prompt tokens NOT re-prefilled thanks to prefix reuse"),
+    # -- prefill pipeline (overlapped chunked prefill + admission control) --
+    Metric("prefill_slice_seconds", HISTOGRAM,
+           "host wall of one prefill-slice dispatch (prep + enqueue; "
+           "long = device-queue pushback)",
+           buckets=LATENCY_BUCKETS),
+    Metric("admission_budget_tokens", GAUGE,
+           "admission controller's live per-wave prefill-token budget"),
+    Metric("lane_idle_seconds", GAUGE,
+           "cumulative idle lane-seconds while other lanes decoded "
+           "(monotonic; the admission controller's raw loss signal)"),
     # -- resilience / error taxonomy (docs/RUNBOOK.md) ---------------------
     Metric("engine_unavailable_total", COUNTER,
            "503s from watchdog trips / recovery in progress"),

@@ -13,6 +13,18 @@ share one KV head into the row dimension, so each grid step is a dense
 dimension — TPU grids execute sequentially, so the running max / sum /
 accumulator live in VMEM scratch across kv steps and the output is written
 once on the final step.
+
+Multi-KV-block inner loop (``kv_unroll``): each grid step fetches a FUSED
+K/V block of ``kv_unroll * block_k`` tokens and iterates the online-softmax
+update over the ``block_k``-sized sub-blocks in-kernel (a trace-time Python
+loop, so the math per sub-block — and therefore the result — is identical
+to the unrolled grid).  Fewer grid launches amortize the per-step block-DMA
+setup that dominates long-context prefill on this platform (docs/PERF.md
+"Roofline, revised": the 8k+ TTFT floor was per-grid-step overhead, not
+FLOPs), at the cost of ``kv_unroll``× the K/V VMEM residency per step.
+``LFKT_FLASH_KV_UNROLL`` sets the default; the causal classifier still
+skips/interior-specializes per sub-block, so a fused block pays VPU mask
+work only for the sub-blocks that need it.
 """
 
 from __future__ import annotations
@@ -34,11 +46,11 @@ def _attn_kernel(
     pos_ref,            # (1,) int32 — cache position of query token 0
     # inputs
     q_ref,              # (1, BQ, hd)
-    k_ref,              # (1, BK, hd) — bf16, or int8 when quantized
-    v_ref,              # (1, BK, hd)
+    k_ref,              # (1, U*BK, hd) — bf16, or int8 when quantized
+    v_ref,              # (1, U*BK, hd)
     # quantized only (absent otherwise): per-token f32 scale blocks
-    #   ks_ref          # (1, BK)
-    #   vs_ref          # (1, BK)
+    #   ks_ref          # (1, U*BK)
+    #   vs_ref          # (1, U*BK)
     # outputs
     *rest,              # o_ref (1, BQ, hd), then scratch:
     # m_ref,            # (BQ, 128) f32  running max (lane-replicated)
@@ -47,6 +59,7 @@ def _attn_kernel(
     seq_len: int,       # S — real (bucketed) query length
     block_q: int,
     block_k: int,
+    kv_unroll: int,     # U — block_k-sized sub-blocks fused per grid step
     sm_scale: float,
     sliding_window: int,
     quantized: bool = False,
@@ -82,72 +95,86 @@ def _attn_kernel(
         t_max = seq_len - 1
     q_min = pos_ref[0] + t_min
     q_max = pos_ref[0] + t_max
-    kmin = kb * block_k
-    kmax = kmin + block_k - 1
 
-    skip = kmin > q_max                            # fully in the masked future
-    if sliding_window:
-        skip |= kmax <= q_min - sliding_window     # fully behind the window
-        interior = jnp.bool_(False)                # window edge → always mask
-    else:
-        interior = kmax <= q_min                   # fully unmasked block
+    # The inner loop over the fused block's sub-blocks is a trace-time
+    # Python loop (``u`` is static), so every sub-block runs the SAME
+    # online-softmax update, in the same order, as the kv_unroll=1 grid —
+    # the result is bit-identical; only the launch count changes.
+    def _sub_block(u: int):
+        kmin = (kb * kv_unroll + u) * block_k
+        kmax = kmin + block_k - 1
 
-    def _body(masked: bool):
-        q = q_ref[0]                               # (BQ, hd)
-        k = k_ref[0]                               # (BK, hd)
-        if quantized:
-            # fused dequant, scale-last: scores are linear in K, so the
-            # per-token scale factors out of the contraction — dot the RAW
-            # int8 block (cast in-register; [-127,127] is exact in any
-            # float), then scale each key column once.  HBM moved int8.
-            k = k.astype(q.dtype)
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * sm_scale                               # (BQ, BK)
-        if quantized:
-            scores = scores * ks_ref[...]          # (1, BK) bcast over rows
+        skip = kmin > q_max                        # fully in the masked future
+        if sliding_window:
+            skip |= kmax <= q_min - sliding_window  # fully behind the window
+            interior = jnp.bool_(False)            # window edge → always mask
+        else:
+            interior = kmax <= q_min               # fully unmasked block
 
-        if masked:
-            row = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            q_pos = pos_ref[0] + jax.lax.rem(row, seq_len)
-            key_pos = kmin + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            mask = key_pos <= q_pos
-            if sliding_window:
-                mask &= key_pos > q_pos - sliding_window
-            scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
+        lo = u * block_k
 
-        m_prev = m_ref[:, :1]                      # (BQ, 1)
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(scores, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)            # rescale of old state
-        p = jnp.exp(scores - m_new)                # (BQ, BK)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        def _body(masked: bool):
+            q = q_ref[0]                           # (BQ, hd)
+            k = k_ref[0, lo:lo + block_k, :]       # (BK, hd)
+            if quantized:
+                # fused dequant, scale-last: scores are linear in K, so the
+                # per-token scale factors out of the contraction — dot the
+                # RAW int8 block (cast in-register; [-127,127] is exact in
+                # any float), then scale each key column once.  HBM moved
+                # int8.
+                k = k.astype(q.dtype)
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale                           # (BQ, BK)
+            if quantized:
+                scores = scores * ks_ref[:, lo:lo + block_k]  # (1, BK) bcast
 
-        v = v_ref[0]                               # (BK, hd)
-        if quantized:
-            # same trick on V: p·(q·s) == (p·s)·q — fold the value scales
-            # into the (BQ, BK) probability tile, contract the raw int8
-            p = p * vs_ref[...]
-            v = v.astype(q_ref.dtype)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+            if masked:
+                row = qb * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                q_pos = pos_ref[0] + jax.lax.rem(row, seq_len)
+                key_pos = kmin + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                mask = key_pos <= q_pos
+                if sliding_window:
+                    mask &= key_pos > q_pos - sliding_window
+                scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
 
-    @pl.when(jnp.logical_and(jnp.logical_not(skip), interior))
-    def _interior():
-        _body(masked=False)
+            m_prev = m_ref[:, :1]                  # (BQ, 1)
+            l_prev = l_ref[:, :1]
+            m_cur = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)        # rescale of old state
+            p = jnp.exp(scores - m_new)            # (BQ, BK)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
-    @pl.when(jnp.logical_and(jnp.logical_not(skip), jnp.logical_not(interior)))
-    def _edge():
-        _body(masked=True)
+            v = v_ref[0, lo:lo + block_k, :]       # (BK, hd)
+            if quantized:
+                # same trick on V: p·(q·s) == (p·s)·q — fold the value
+                # scales into the (BQ, BK) probability tile, contract the
+                # raw int8
+                p = p * vs_ref[:, lo:lo + block_k]
+                v = v.astype(q_ref.dtype)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[...] = acc_ref[...] * alpha + pv
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(jnp.logical_and(jnp.logical_not(skip), interior))
+        def _interior():
+            _body(masked=False)
+
+        @pl.when(jnp.logical_and(jnp.logical_not(skip),
+                                 jnp.logical_not(interior)))
+        def _edge():
+            _body(masked=True)
+
+    for u in range(kv_unroll):
+        _sub_block(u)
 
     @pl.when(kb == pl.num_programs(2) - 1)
     def _finish():
@@ -163,9 +190,23 @@ def _pick_block(n: int, preferred: int) -> int:
     return n
 
 
+def _env_kv_unroll() -> int:
+    """The ``LFKT_FLASH_KV_UNROLL`` default, read through the knob registry
+    (lfkt-lint CFG001) at trace time — the qmatmul ``_env_variant``
+    convention: env knobs for kernel geometry are process-lifetime choices
+    baked into the compiled programs at first trace."""
+    from ...utils.config import knob
+
+    u = int(knob("LFKT_FLASH_KV_UNROLL"))
+    if u < 1:
+        raise ValueError(f"LFKT_FLASH_KV_UNROLL must be >= 1, got {u}")
+    return u
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("sm_scale", "sliding_window", "block_q", "block_k", "interpret"),
+    static_argnames=("sm_scale", "sliding_window", "block_q", "block_k",
+                     "kv_unroll", "interpret"),
 )
 def flash_attention(
     q: jax.Array,          # (S, n_heads, hd)
@@ -176,6 +217,8 @@ def flash_attention(
     sliding_window: int = 0,
     block_q: int = 512,
     block_k: int = 1024,
+    kv_unroll: int | None = None,  # block_k sub-blocks fused per grid step
+    #                                (None: LFKT_FLASH_KV_UNROLL)
     k_scale: jax.Array | None = None,  # (n_kv, n_ctx) f32 — int8 cache only
     v_scale: jax.Array | None = None,
     interpret: bool = False,
@@ -191,6 +234,13 @@ def flash_attention(
     scales, docs/KV_CACHE.md), K/V are int8 and the kernel dequantizes
     in-register — the ring's HBM traffic roughly halves, which is the
     whole point of ``kv_dtype=int8`` on a bandwidth-bound decode chip.
+
+    ``kv_unroll`` fuses that many ``block_k`` sub-blocks into one grid
+    step's K/V fetch and runs the online softmax over them in-kernel —
+    numerically identical to the unrolled grid (same sub-block math, same
+    order), but with ``kv_unroll``× fewer grid launches to pay per-step
+    block-DMA setup for.  Clamped so the fused block still divides
+    ``n_ctx`` (tiny rings degrade gracefully to the plain grid).
     """
     S, n_heads, hd = q.shape
     n_kv, n_ctx, _ = k.shape
@@ -200,32 +250,40 @@ def flash_attention(
 
     bq = _pick_block(gs, block_q)
     bk = _pick_block(n_ctx, block_k)
+    if kv_unroll is None:
+        kv_unroll = _env_kv_unroll()
+    # largest unroll <= requested whose fused block divides the ring
+    u = max(1, min(int(kv_unroll), n_ctx // bk))
+    while u > 1 and n_ctx % (bk * u):
+        u -= 1
+    bkf = bk * u                                   # fused K/V block
 
     # (S, n_kv, group, hd) → (n_kv, group*S, hd): row = g*S + s
     qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3).reshape(n_kv, gs, hd)
     kk = k                                         # (n_kv, n_ctx, hd)
     vv = v
 
-    grid = (n_kv, gs // bq, n_ctx // bk)
+    grid = (n_kv, gs // bq, n_ctx // bkf)
     kernel = functools.partial(
         _attn_kernel,
         seq_len=S,
         block_q=bq,
         block_k=bk,
+        kv_unroll=u,
         sm_scale=sm_scale,
         sliding_window=sliding_window,
         quantized=quantized,
     )
     in_specs = [
         pl.BlockSpec((1, bq, hd), lambda h, qb, kb, *_: (h, qb, 0)),
-        pl.BlockSpec((1, bk, hd), lambda h, qb, kb, *_: (h, kb, 0)),
-        pl.BlockSpec((1, bk, hd), lambda h, qb, kb, *_: (h, kb, 0)),
+        pl.BlockSpec((1, bkf, hd), lambda h, qb, kb, *_: (h, kb, 0)),
+        pl.BlockSpec((1, bkf, hd), lambda h, qb, kb, *_: (h, kb, 0)),
     ]
     operands = [qg, kk, vv]
     if quantized:
         in_specs += [
-            pl.BlockSpec((1, bk), lambda h, qb, kb, *_: (h, kb)),
-            pl.BlockSpec((1, bk), lambda h, qb, kb, *_: (h, kb)),
+            pl.BlockSpec((1, bkf), lambda h, qb, kb, *_: (h, kb)),
+            pl.BlockSpec((1, bkf), lambda h, qb, kb, *_: (h, kb)),
         ]
         operands += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
     out = pl.pallas_call(

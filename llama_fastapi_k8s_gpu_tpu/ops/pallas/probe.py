@@ -143,7 +143,7 @@ def probe_flash_attention(quantized: bool = False) -> str | None:
         import jax.numpy as jnp
 
         from . import use_interpret
-        from .attention import flash_attention
+        from .attention import _env_kv_unroll, flash_attention
 
         itp = use_interpret()
         S, H, KV, HD, CTX = (8, 2, 2, 128, 32) if itp else (128, 32, 8, 128, 256)
@@ -160,6 +160,27 @@ def probe_flash_attention(quantized: bool = False) -> str | None:
             y = flash_attention(q, k, v, jnp.int32(0), sm_scale=HD ** -0.5,
                                 interpret=itp)
         float(y.astype(jnp.float32).sum())
+        if _env_kv_unroll() > 1:
+            # the multi-KV-block inner loop (LFKT_FLASH_KV_UNROLL > 1) is a
+            # structurally different Mosaic program (fused K/V fetch +
+            # in-kernel sub-block loop); probe it at small explicit blocks
+            # so a lowering failure degrades attn_impl instead of crashing
+            # the first long-context prefill.  The probe shapes above clamp
+            # the unroll to 1 (ring == one block), so they cannot cover it.
+            ctx2 = 4 * 128
+            if quantized:
+                k2 = jnp.ones((KV, ctx2, HD), jnp.int8)
+                ks2 = jnp.full((KV, ctx2), 1 / 127.0, jnp.float32)
+                y = flash_attention(q, k2, k2, jnp.int32(0),
+                                    sm_scale=HD ** -0.5, block_q=128,
+                                    block_k=128, k_scale=ks2, v_scale=ks2,
+                                    interpret=itp)
+            else:
+                k2 = jnp.ones((KV, ctx2, HD), jnp.bfloat16)
+                y = flash_attention(q, k2, k2, jnp.int32(0),
+                                    sm_scale=HD ** -0.5, block_q=128,
+                                    block_k=128, interpret=itp)
+            float(y.astype(jnp.float32).sum())
         return None
     except Exception as e:  # noqa: BLE001
         return _err(e)

@@ -575,6 +575,11 @@ def create_app(engine=None, settings: Settings | None = None,
             "batch_traces": hasattr(engine, "create_chat_completions")
             and _accepts_kwarg(engine.create_chat_completions, "traces"),
         }
+        # engines observe prefill-slice timings straight into the app's
+        # registry (obs/catalog.py prefill_slice_seconds); attribute
+        # injection, not an import, so library/bench engines stay free
+        if hasattr(engine, "metrics_sink"):
+            engine.metrics_sink = app.state.metrics
         app.state.ready = True
         app.state.health.transition(READY, "engine loaded")
         if settings.watchdog and getattr(engine, "heartbeat", None) is not None:
@@ -842,12 +847,22 @@ def create_app(engine=None, settings: Settings | None = None,
             m.set_gauge("kv_cache_bytes", kv_bytes)
         stats = getattr(app.state.engine, "scheduler_stats", None)
         if stats is not None:
-            for k, v in stats().items():
+            snap = stats()
+            for k, v in snap.items():
                 if isinstance(v, dict):   # nested stats (e.g. spec): flatten
                     for kk, vv in v.items():  # — a dict-valued gauge renders
                         m.set_gauge(f"scheduler_{k}_{kk}", vv)  # invalid lines
                 else:
                     m.set_gauge(f"scheduler_{k}", v)
+            # first-class prefill-pipeline gauges (obs/catalog.py): the
+            # admission controller's live budget + cumulative idle
+            # lane-seconds, promoted out of the scheduler_ prefix family
+            # so dashboards need no family-scrape to alert on them
+            if "adm_budget_tokens" in snap:
+                m.set_gauge("admission_budget_tokens",
+                            snap["adm_budget_tokens"])
+            if "lane_idle_seconds" in snap:
+                m.set_gauge("lane_idle_seconds", snap["lane_idle_seconds"])
         tstats = app.state.tracer.stats()
         m.set_gauge("trace_ring_used", tstats["ring_used"])
         m.set_gauge("traces_started_total", tstats["started_total"])
@@ -967,6 +982,8 @@ def _default_engine_factory(settings: Settings):
             spec_decode=settings.spec_decode,
             spec_draft=settings.spec_draft,
             prefix_cache=settings.prefix_cache,
+            prefill_chunk=settings.prefill_chunk,
+            prefill_overlap=settings.prefill_overlap,
         )
         if settings.scheduler not in ("continuous", "cycle"):
             raise ValueError(
@@ -982,12 +999,16 @@ def _default_engine_factory(settings: Settings):
                            tp=settings.mesh_tp, **kw)
         elif settings.batch_size > 1:
             if settings.scheduler == "continuous":
+                ckw = dict(kw)
+                ckw.pop("prefill_chunk")   # named explicitly below
                 eng = ContinuousEngine(
                     settings.model_path, tp=settings.mesh_tp,
                     batch_size=settings.batch_size,
                     prefill_chunk=settings.prefill_chunk,
                     adm_budget=settings.adm_budget,
-                    lane_prefix_cache=settings.lane_prefix_cache, **kw)
+                    adm_controller=settings.adm_controller,
+                    adm_ema_alpha=settings.adm_ema_alpha,
+                    lane_prefix_cache=settings.lane_prefix_cache, **ckw)
             else:
                 eng = MeshEngine(settings.model_path, tp=settings.mesh_tp,
                                  batch_size=settings.batch_size, **kw)

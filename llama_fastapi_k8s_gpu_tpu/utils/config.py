@@ -135,14 +135,35 @@ class Settings:
     prefix_cache: bool = True
     # the continuous scheduler's analogue: admissions whose prompt shares
     # a freed lane's conversation history snapshot that lane's KV and
-    # prefill only the suffix slices (chunk-aligned).  Off by default —
-    # the admission path is the scheduler's measured bottleneck, so flip
-    # this knob deliberately per deployment.
-    lane_prefix_cache: bool = False
-    prefill_chunk: int = 256        # continuous-scheduler admission slice size
+    # prefill only the suffix slices (chunk-aligned).  ON by default since
+    # the admission controller closed the admission/decode interference
+    # gap (round 6); explicit-seed requests still bypass it (the
+    # reproducibility contract) and spec decode still excludes it.
+    lane_prefix_cache: bool = True
+    prefill_chunk: int = 256        # prefill slice size: the continuous
+    #                                 scheduler's admission slices AND the
+    #                                 serial engine's overlapped bucket
+    #                                 slices (docs/RUNBOOK.md "Tuning
+    #                                 long-context TTFT")
+    # serial-engine overlapped chunked prefill: how many un-synced prefill
+    # slices may queue on the device at once (slice i+1's host prep +
+    # dispatch overlap slice i's compute).  0 restores monolithic
+    # bucket-sized prefill; slicing only engages when the prompt bucket
+    # exceeds prefill_chunk, so short prompts are untouched either way.
+    prefill_overlap: int = 2
     adm_budget: int = 512           # admission prefill tokens per scheduler
-    #                                 iteration (several short admissions,
-    #                                 or slices of one long prompt)
+    #                                 wave: the static value when the
+    #                                 admission controller is off, and the
+    #                                 controller's initial/base budget when
+    #                                 it is on
+    # admission controller (engine/continuous.py AdmissionController):
+    # derives each wave's prefill-token budget from an EMA of measured
+    # lane-idle fraction and decode slack (harvest-fetch wait) instead of
+    # the static adm_budget — budget rises while lanes sit idle, shrinks
+    # under decode pressure, and never drops below one slice per wave (a
+    # deadline-bearing admission always makes progress).
+    adm_controller: bool = True
+    adm_ema_alpha: float = 0.25     # EMA weight of the controller's signals
     # >1 switches the server to mesh-batched serving — the v5e-4
     # "concurrent /response load" config.  scheduler picks the flavor:
     #   cycle      — MeshEngine: coalesce up to batch_size queued requests
@@ -242,8 +263,16 @@ KNOBS: dict[str, Knob] = _register(
     Knob("LFKT_SPEC_DRAFT", int, "draft tokens per verify step"),
     Knob("LFKT_PREFIX_CACHE", bool, "serial-engine prompt-prefix KV reuse"),
     Knob("LFKT_LANE_PREFIX_CACHE", bool, "lane-claim admission KV reuse"),
-    Knob("LFKT_PREFILL_CHUNK", int, "scheduler admission slice tokens"),
-    Knob("LFKT_ADM_BUDGET", int, "admission tokens per scheduler iteration"),
+    Knob("LFKT_PREFILL_CHUNK", int, "prefill slice tokens (admission + "
+         "serial overlapped prefill)"),
+    Knob("LFKT_PREFILL_OVERLAP", int,
+         "overlapped-prefill depth (0 = monolithic bucket prefill)"),
+    Knob("LFKT_ADM_BUDGET", int,
+         "admission tokens per wave (controller base / static value)"),
+    Knob("LFKT_ADM_CONTROLLER", bool,
+         "EMA admission controller for the per-wave prefill budget"),
+    Knob("LFKT_ADM_EMA_ALPHA", float,
+         "admission-controller EMA weight"),
     Knob("LFKT_BATCH_SIZE", int, "serving lanes (mesh/continuous batching)"),
     Knob("LFKT_SCHEDULER", str, "continuous|cycle batching flavor"),
     Knob("LFKT_MESH_TP", int, "tensor-parallel width"),
@@ -283,6 +312,9 @@ KNOBS: dict[str, Knob] = _register(
     Knob("LFKT_FAULTS", str,
          "fault-injection arming spec (utils/faults.py; drills only)",
          default=""),
+    Knob("LFKT_FLASH_KV_UNROLL", int,
+         "flash-attention fused KV sub-blocks per grid step "
+         "(ops/pallas/attention.py)", default=4),
     Knob("LFKT_Q4K_KERNEL", str, "fused Q4_K kernel variant (A/B)",
          default=""),
     Knob("LFKT_Q5K_KERNEL", str, "fused Q5_K kernel variant (A/B)",

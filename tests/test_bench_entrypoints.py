@@ -110,6 +110,28 @@ def test_bench_tiny_smoke():
     assert "int8" in parsed["metric"]
 
 
+def test_bench_ttft_sweep_tiny_smoke():
+    """--ttft-sweep: one valid JSON line PER grid point (ctx × chunk),
+    each carrying the pipeline attribution (chunk, overlap, kv_unroll)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", LFKT_BENCH_PRESET="tiny",
+               LFKT_BENCH_TTFT_SWEEP="1")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ttft-sweep"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln.strip()]
+    points = [p for p in lines if "ttft-sweep" in p.get("metric", "")]
+    # tiny grid: 2 contexts × (mono + chunk16) = 4 points
+    assert len(points) == 4, out.stdout
+    assert {p["n_ctx"] for p in points} == {64, 128}
+    assert {p["prefill_chunk"] for p in points} == {0, 16}
+    for p in points:
+        assert p["value"] > 0
+        assert p["unit"] == "ms"
+        assert "kv_unroll" in p and "prefill_overlap" in p
+        assert len(p["samples_ms"]) == 5
+
+
 def test_bench_server_tiny_smoke():
     parsed, out = _run("bench_server.py",
                        extra_env={"LFKT_BENCH_N_REQ": "4",

@@ -328,6 +328,23 @@ def test_decode_progresses_during_admission_wave(cengine):
     admission, where the round-2 loop stalled for the whole wave."""
     import time as _time
 
+    # steady-state warmup (the same hygiene as
+    # test_chunked_prefill_bounds_stall_per_slice): a live stream plus
+    # concurrent admissions compile every program the measured phase uses
+    # — slice prefill, the deferred-first-token path, lane writes — so
+    # the gap assertion measures scheduling, not first-use jit compiles
+    # (the module fixture deliberately skips engine.warmup(); run solo,
+    # this test would otherwise time ~3 s of compiles into one gap)
+    warm_it = iter(cengine.submit_stream(
+        [{"role": "user", "content": "warm stream"}],
+        temperature=0.0, max_tokens=8))
+    next(warm_it)
+    warm = [cengine.submit([{"role": "user", "content": f"warm {j}"}],
+                           temperature=0.0, max_tokens=2) for j in range(2)]
+    list(warm_it)
+    for f in warm:
+        f.result(timeout=120)
+
     delay = 0.25
     n_wave = 4
     orig = cengine._dispatch_prefill_chunk
@@ -340,11 +357,15 @@ def test_decode_progresses_during_admission_wave(cengine):
         return orig(adm)
 
     cengine._dispatch_prefill_chunk = slow_chunk
-    # pin the per-iteration admission budget to ONE slice for this test:
-    # the decode-overlap bound being verified is per-admission; the default
-    # budget intentionally admits several short requests per iteration
+    # pin the per-wave admission budget to ONE slice for this test (and
+    # park the admission controller, which would otherwise rewrite the
+    # budget every wave): the decode-overlap bound being verified is
+    # per-admission; the default budget intentionally admits several short
+    # requests per iteration
     # (test_concurrent_admissions_in_one_round_are_correct covers that)
     budget_saved = cengine._adm_budget
+    ctl_saved = cengine._adm_ctl
+    cengine._adm_ctl = None
     cengine._adm_budget = 1
     try:
         stream = cengine.submit_stream(
@@ -371,6 +392,7 @@ def test_decode_progresses_during_admission_wave(cengine):
         assert max(gaps) < (n_wave - 1) * delay, gaps
     finally:
         cengine._dispatch_prefill_chunk = orig
+        cengine._adm_ctl = ctl_saved
         cengine._adm_budget = budget_saved
 
 
@@ -382,16 +404,25 @@ def test_chunked_prefill_bounds_stall_per_slice(tmp_path):
 
     path = str(tmp_path / "tiny.gguf")
     write_tiny_llama_gguf(path)
+    # static one-slice budget (controller off): this test pins the
+    # per-SLICE stall bound; the controller's budget-driven multi-slice
+    # interleave is covered by tests/test_admission.py
     eng = ContinuousEngine(path, dp=2, tp=2, batch_size=2, n_ctx=128,
                            decode_chunk=4, max_gen_tokens=24,
-                           prefill_buckets=(64,), prefill_chunk=16)
+                           prefill_buckets=(64,), prefill_chunk=16,
+                           adm_budget=16, adm_controller=False)
     try:
         # compile the slice/decode programs first so measured gaps are
         # steady-state scheduling, not first-use jit compiles
         eng.submit([{"role": "user", "content": "y " * 40}],
                    temperature=0.0, max_tokens=2).result(timeout=300)
 
-        delay = 0.15
+        # delay sized so the two outcomes stay separated on a contended
+        # full-suite box: per-slice interleaving gaps ≈ delay (+ scheduler
+        # noise measured up to ~0.2 s), a monolithic 4-slice stall ≥
+        # 4×delay = 1.0 s — the 3×delay bound sits between with margin
+        # on both sides (0.15/0.45 flaked at 0.474 under suite load)
+        delay = 0.25
         orig = eng._dispatch_prefill_chunk
         n_slices = []
 
@@ -434,8 +465,14 @@ def test_scheduler_stats_surface(cengine):
     cengine.create_chat_completion(MSGS, temperature=0.0, max_tokens=4)
     stats = cengine.scheduler_stats()
     assert stats["batch_size"] == 4
-    assert set(stats) == {"batch_size", "lanes_live", "pending",
-                          "admission_inflight"}
+    assert {"batch_size", "lanes_live", "pending",
+            "admission_inflight"} <= set(stats)
+    # prefill-pipeline surface: live admission budget (+ controller EMAs —
+    # the default engine runs the controller) and cumulative idle seconds
+    assert stats["adm_budget_tokens"] >= cengine._prefill_chunk
+    assert 0.0 <= stats["adm_ema_idle"] <= 1.0
+    assert 0.0 <= stats["adm_ema_pressure"] <= 1.0
+    assert stats["lane_idle_seconds"] >= 0.0
     deadline = time.time() + 10
     while time.time() < deadline and cengine.scheduler_stats()["lanes_live"]:
         time.sleep(0.05)
@@ -603,6 +640,57 @@ def test_lane_prefix_reuse_on_sharded_mesh(tmp_path):
                                         temperature=0.0, max_tokens=8)
         assert t2["lfkt_timings"]["prefix_reused_tokens"] >= 16
         assert t2["choices"][0]["message"]["content"]
+    finally:
+        eng.shutdown()
+
+
+def test_lane_prefix_cache_defaults_on(tmp_path):
+    """Round 6 flips LFKT_LANE_PREFIX_CACHE on: a default-constructed
+    ContinuousEngine (and default Settings) serve with lane-claim reuse
+    armed, and the interference regression that kept it off is guarded —
+    a prefill-heavy admission wave through a default engine still matches
+    the serial engine's greedy outputs request-for-request (reuse never
+    fires across DISTINCT prompts; the multi-turn reuse path itself is
+    covered by the lp_engine tests above)."""
+    from llama_fastapi_k8s_gpu_tpu.utils.config import Settings, get_settings
+
+    assert Settings.lane_prefix_cache is True
+    assert get_settings().lane_prefix_cache is True
+
+    path = str(tmp_path / "tiny-lp-default.gguf")
+    write_tiny_llama_gguf(path)
+    eng = ContinuousEngine(path, dp=1, tp=1, batch_size=2, n_ctx=128,
+                           decode_chunk=4, max_gen_tokens=16,
+                           prefill_buckets=(32, 64, 128))
+    serial = Engine(path, n_ctx=128, decode_chunk=4, max_gen_tokens=16,
+                    prefill_buckets=(32, 64, 128), prefix_cache=False)
+    try:
+        assert eng._lane_prefix is True          # the flipped default
+        prompts = [[{"role": "user", "content": f"default wave {i} "
+                     * (1 + i % 3)}] for i in range(6)]
+        want = [serial.create_chat_completion(p, temperature=0.0,
+                                              max_tokens=6)
+                ["choices"][0]["message"]["content"] for p in prompts]
+        futs = [eng.submit(p, temperature=0.0, max_tokens=6)
+                for p in prompts]
+        got = [f.result(timeout=120)["choices"][0]["message"]["content"]
+               for f in futs]
+        assert got == want
+    finally:
+        eng.shutdown()
+
+
+def test_lane_prefix_spec_decode_still_excluded(tmp_path):
+    """The default flip must not arm reuse under spec decode (verify
+    rounds leave rejected drafts in lanes — the documented exclusion)."""
+    path = str(tmp_path / "tiny-lp-spec.gguf")
+    write_tiny_llama_gguf(path)
+    eng = ContinuousEngine(path, dp=1, tp=1, batch_size=2, n_ctx=128,
+                           decode_chunk=4, max_gen_tokens=16,
+                           prefill_buckets=(32, 64, 128),
+                           spec_decode="lookup", spec_draft=4)
+    try:
+        assert eng._lane_prefix is False
     finally:
         eng.shutdown()
 

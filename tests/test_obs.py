@@ -684,3 +684,51 @@ def test_trace_report_waterfall(model_path):
     assert "█" in text
     listing = mod.render_listing({"traces": t.traces()})
     assert tr.trace_id in listing
+
+
+@pytest.mark.anyio
+async def test_server_installs_metrics_sink_and_slice_histogram(model_path):
+    """The app injects its Metrics registry into the engine at startup
+    (engine.metrics_sink); a sliced prefill then lands observations in the
+    prefill_slice_seconds histogram on /metrics."""
+    from tests.test_server import lifespan_client, make_client
+
+    eng = Engine(model_path, n_ctx=128, decode_chunk=4, max_gen_tokens=16,
+                 prefill_buckets=(32, 64, 128), prefix_cache=False,
+                 prefill_chunk=16, prefill_overlap=2)
+    app, transport = make_client(eng)
+    async with transport:
+        await app.router.startup()
+        async with await lifespan_client(app, transport) as client:
+            assert eng.metrics_sink is app.state.metrics
+            body = dict(TINY_BODY)
+            body["context"] = [{"turn": "user",
+                                "message": "one two three four five " * 2}]
+            r = await client.post("/response", json=body)
+            assert r.status_code == 200
+            m = (await client.get("/metrics")).text
+            assert "# TYPE prefill_slice_seconds histogram" in m
+            count = re.search(r"prefill_slice_seconds_count (\d+)", m)
+            assert count is not None and int(count.group(1)) >= 2
+        await app.router.shutdown()
+
+
+def test_trace_report_renders_prefill_slice_overlap(model_path):
+    """A sliced prefill's per-slice events render as ▒ duration bars
+    (offset-labeled) tiling the prefill span — the round-6 overlap view."""
+    eng = Engine(model_path, n_ctx=128, decode_chunk=4, max_gen_tokens=16,
+                 prefill_buckets=(32, 64, 128), prefix_cache=False,
+                 prefill_chunk=16, prefill_overlap=2)
+    t = Tracer(sample=1.0, ring=4)
+    tr = t.start()
+    eng.create_chat_completion(
+        [{"role": "user", "content": "one two three four five six " * 2}],
+        temperature=0.0, max_tokens=4, trace=tr)
+    t.finish(tr)
+    mod = _load_trace_report()
+    text = mod.render_trace(tr.to_dict())
+    assert "▒" in text, text
+    slices = re.findall(r"slice@(\d+)", text)
+    assert len(slices) >= 2, text                 # multi-slice prompt
+    assert [int(s) for s in slices] == sorted(int(s) for s in slices)
+    assert re.search(r"slice@\d+.*n=\d+", text)   # token count rides along

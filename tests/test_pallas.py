@@ -161,6 +161,65 @@ def test_flash_attention_block_branches(S, n_ctx, H, n_kv, hd, offset,
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize(
+    "S,n_ctx,H,n_kv,hd,offset,window,unroll",
+    [
+        # the multi-KV-block inner loop (LFKT_FLASH_KV_UNROLL): fused K/V
+        # blocks with in-kernel sub-block iteration must match the oracle
+        # across the same branch zoo as the plain grid
+        (64, 256, 4, 2, 32, 0, 0, 2),      # causal from empty cache
+        (64, 256, 4, 2, 32, 100, 0, 4),    # offset continuation
+        (64, 256, 4, 2, 32, 100, 48, 2),   # sliding window edges
+        (64, 256, 4, 2, 32, 0, 0, 8),      # whole ring in ONE grid step
+        (24, 96, 4, 2, 32, 5, 0, 3),       # conservative-span path, odd U
+    ],
+)
+def test_flash_attention_kv_unroll_matches_xla(S, n_ctx, H, n_kv, hd,
+                                               offset, window, unroll):
+    keys = jax.random.split(jax.random.PRNGKey(11 * S + offset + unroll), 3)
+    q = jax.random.normal(keys[0], (S, H, hd), jnp.float32)
+    k = jax.random.normal(keys[1], (n_kv, n_ctx, hd), jnp.float32)
+    v = jax.random.normal(keys[2], (n_kv, n_ctx, hd), jnp.float32)
+    sm = hd ** -0.5
+    got = flash_attention(
+        q, k, v, jnp.int32(offset), sm_scale=sm, sliding_window=window,
+        block_q=16, block_k=32, kv_unroll=unroll, interpret=True,
+    )
+    want = _ref_attention(q, k, v, jnp.int32(offset), sm, window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_kv_unroll_bit_identical_to_plain_grid():
+    """The fused block runs the SAME online-softmax updates in the same
+    order as the unrolled grid — the outputs must be bit-identical, not
+    just close (the greedy-parity contract of the prefill pipeline rests
+    on this)."""
+    keys = jax.random.split(jax.random.PRNGKey(99), 3)
+    q = jax.random.normal(keys[0], (32, 4, 32), jnp.float32)
+    k = jax.random.normal(keys[1], (2, 128, 32), jnp.float32)
+    v = jax.random.normal(keys[2], (2, 128, 32), jnp.float32)
+    kw = dict(sm_scale=32 ** -0.5, block_q=16, block_k=32, interpret=True)
+    base = flash_attention(q, k, v, jnp.int32(17), kv_unroll=1, **kw)
+    for u in (2, 4):
+        fused = flash_attention(q, k, v, jnp.int32(17), kv_unroll=u, **kw)
+        assert (np.asarray(base) == np.asarray(fused)).all(), u
+
+
+def test_flash_attention_kv_unroll_clamps_to_ring():
+    """A tiny ring (one kv block) silently degrades to the plain grid —
+    an oversized LFKT_FLASH_KV_UNROLL must never be a crash."""
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(keys[0], (8, 2, 32), jnp.float32)
+    k = jax.random.normal(keys[1], (2, 32, 32), jnp.float32)
+    v = jax.random.normal(keys[2], (2, 32, 32), jnp.float32)
+    got = flash_attention(q, k, v, jnp.int32(0), sm_scale=32 ** -0.5,
+                          kv_unroll=64, interpret=True)
+    want = _ref_attention(q, k, v, jnp.int32(0), 32 ** -0.5, 0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_prefill_pallas_matches_xla_end_to_end():
     """Full model forward: logits with attn_impl=pallas ≈ attn_impl=xla."""
     cfg = ModelConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,

@@ -1,0 +1,182 @@
+"""Overlapped chunked prefill (round 6): greedy-bit-identity contracts.
+
+The prefill pipeline slices bucket prefill into ``prefill_chunk``-token
+pieces and double-buffers their dispatch (engine/engine.py
+``_prefill_padded``; the continuous scheduler's admission machine in
+engine/continuous.py).  The load-bearing invariant: slicing changes WHEN
+device work is dispatched, never WHAT a greedy request produces — pinned
+here against the monolithic path on all four engine flavors (serial,
+mesh-batched, continuous, sequence-parallel).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.engine import (
+    ContinuousEngine,
+    Engine,
+    MeshEngine,
+    SPEngine,
+)
+from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+from llama_fastapi_k8s_gpu_tpu.testing import TINY_CFG, write_tiny_llama_gguf
+
+BUCKETS = (32, 64, 128)
+
+#: prompts chosen to span buckets: multi-slice (several 16-token slices),
+#: single-slice, and a bucket-boundary straddler
+PROMPTS = [
+    [{"role": "user", "content": "Say something."}],
+    [{"role": "user", "content": "alpha bravo charlie delta echo " * 4}],
+    [{"role": "user", "content": "one two three four five six seven " * 8}],
+]
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("model") / "tiny.gguf")
+    write_tiny_llama_gguf(path, cfg=ModelConfig(
+        **{**TINY_CFG.__dict__, "n_ctx": 512}))
+    return path
+
+
+def _texts(eng, prompts=PROMPTS, max_tokens=8):
+    return [eng.create_chat_completion(p, temperature=0.0,
+                                       max_tokens=max_tokens)
+            ["choices"][0]["message"]["content"] for p in prompts]
+
+
+@pytest.fixture(scope="module")
+def mono_texts(model_path):
+    """The reference outputs: serial engine, monolithic bucket prefill
+    (prefill_overlap=0), no prefix reuse."""
+    eng = Engine(model_path, n_ctx=512, decode_chunk=4, max_gen_tokens=16,
+                 prefill_buckets=BUCKETS, prefix_cache=False,
+                 prefill_overlap=0)
+    return _texts(eng)
+
+
+def test_serial_chunked_overlapped_matches_monolithic(model_path, mono_texts):
+    for overlap in (1, 2, 4):
+        eng = Engine(model_path, n_ctx=512, decode_chunk=4, max_gen_tokens=16,
+                     prefill_buckets=BUCKETS, prefix_cache=False,
+                     prefill_chunk=16, prefill_overlap=overlap)
+        assert _texts(eng) == mono_texts, overlap
+
+
+def test_serial_slicing_actually_engages(model_path):
+    """White-box: the multi-bucket prompt really runs the slice walk (the
+    parity above must not pass because slicing silently never fired)."""
+    eng = Engine(model_path, n_ctx=512, decode_chunk=4, max_gen_tokens=16,
+                 prefill_buckets=BUCKETS, prefix_cache=False,
+                 prefill_chunk=16, prefill_overlap=2)
+    assert eng._slices_prefill(64)
+    assert not eng._slices_prefill(16)   # bucket == slice: monolithic
+    calls = []
+    orig = eng._prefill_padded
+
+    def spy(ids, n_prompt, bucket, cache, pspan=None):
+        calls.append((n_prompt, bucket))
+        return orig(ids, n_prompt, bucket, cache, pspan=pspan)
+
+    eng._prefill_padded = spy
+    eng.create_chat_completion(PROMPTS[2], temperature=0.0, max_tokens=4)
+    assert calls and calls[0][1] > eng._prefill_chunk
+
+
+def test_mesh_serial_path_chunked_matches_monolithic(model_path, mono_texts):
+    """MeshEngine's serial (stream) path rides Engine._start: sliced
+    prefill there must keep greedy parity too."""
+    eng = MeshEngine(model_path, dp=2, tp=2, batch_size=2, n_ctx=512,
+                     decode_chunk=4, max_gen_tokens=16,
+                     prefill_buckets=BUCKETS, prefix_cache=False,
+                     prefill_chunk=16, prefill_overlap=2)
+    assert _texts(eng) == mono_texts
+
+
+def test_mesh_batched_matches_monolithic(model_path, mono_texts):
+    """The batched prefill program stays monolithic; its outputs must agree
+    with the serial monolithic reference (and therefore with the sliced
+    path, by the test above)."""
+    eng = MeshEngine(model_path, dp=2, tp=2, batch_size=2, n_ctx=512,
+                     decode_chunk=4, max_gen_tokens=16,
+                     prefill_buckets=BUCKETS, prefix_cache=False,
+                     prefill_chunk=16, prefill_overlap=2)
+    got = [eng.create_chat_completions([p], temperature=0.0, max_tokens=8)[0]
+           ["choices"][0]["message"]["content"] for p in PROMPTS]
+    assert got == mono_texts
+
+
+def test_continuous_chunked_admission_matches_monolithic(model_path,
+                                                         mono_texts):
+    """The scheduler's chunked admission (with the admission controller ON,
+    the default) is greedy-identical to serial monolithic prefill."""
+    eng = ContinuousEngine(model_path, dp=1, tp=1, batch_size=2, n_ctx=512,
+                           decode_chunk=4, max_gen_tokens=16,
+                           prefill_buckets=BUCKETS, prefill_chunk=16,
+                           lane_prefix_cache=False)
+    try:
+        assert _texts(eng) == mono_texts
+    finally:
+        eng.shutdown()
+
+
+def test_sp_engine_matches_monolithic(model_path, mono_texts):
+    """SPEngine gates slicing off (_SLICE_PREFILL: its ring is sp-sharded
+    over n_ctx) — passing the pipeline knobs must be a no-op that keeps
+    serial parity."""
+    eng = SPEngine(model_path, sp=2, tp=1, n_ctx=512, decode_chunk=4,
+                   max_gen_tokens=16, prefill_buckets=BUCKETS,
+                   prefix_cache=False, prefill_chunk=16, prefill_overlap=2)
+    assert not eng._slices_prefill(128)
+    assert _texts(eng) == mono_texts
+
+
+def test_serial_prefix_reuse_composes_with_slicing(model_path):
+    """Multi-turn follow-ups keep taking the suffix-reuse path (reuse > 0)
+    with slicing enabled, and responses stay well-formed."""
+    eng = Engine(model_path, n_ctx=512, decode_chunk=4, max_gen_tokens=16,
+                 prefill_buckets=BUCKETS, prefill_chunk=16,
+                 prefill_overlap=2, prefix_min=8)
+    msgs = [{"role": "system", "content": "You answer carefully. " * 4},
+            {"role": "user", "content": "Tell me something interesting."}]
+    t1 = eng.create_chat_completion(msgs, temperature=0.0, max_tokens=8)
+    msgs = msgs + [
+        {"role": "assistant",
+         "content": t1["choices"][0]["message"]["content"]},
+        {"role": "user", "content": "And another one."}]
+    t2 = eng.create_chat_completion(msgs, temperature=0.0, max_tokens=8)
+    assert t2["lfkt_timings"]["prefix_reused_tokens"] > 0
+    assert t2["choices"][0]["message"]["content"]
+
+
+def test_slice_events_on_prefill_span(model_path):
+    """A traced sliced prefill carries one prefill_slice event per slice,
+    each with offset/tokens/host_s — the waterfall's overlap rendering
+    (tools/trace_report.py) keys off these attrs."""
+    from llama_fastapi_k8s_gpu_tpu.obs.trace import Tracer
+
+    eng = Engine(model_path, n_ctx=512, decode_chunk=4, max_gen_tokens=16,
+                 prefill_buckets=BUCKETS, prefix_cache=False,
+                 prefill_chunk=16, prefill_overlap=2)
+    tracer = Tracer(sample=1.0, ring=4)
+    tr = tracer.start()
+    eng.create_chat_completion(PROMPTS[2], temperature=0.0, max_tokens=4,
+                               trace=tr)
+    tracer.finish(tr)
+    doc = tr.to_dict()
+    prefill = None
+    stack = [doc["root"]]
+    while stack:
+        s = stack.pop()
+        if s["name"] == "prefill":
+            prefill = s
+        stack.extend(s["children"])
+    assert prefill is not None
+    events = [e for e in prefill["events"] if e["name"] == "prefill_slice"]
+    assert len(events) >= 2                      # multi-slice prompt
+    offs = [e["offset"] for e in events]
+    assert offs == sorted(offs)
+    for e in events:
+        assert e["tokens"] > 0 and e["host_s"] >= 0.0

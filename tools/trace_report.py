@@ -93,6 +93,22 @@ def render_trace(trace: dict) -> str:
         for ev in span.get("events", ()):
             at = ev["at"] - t0
             mark = min(int(at / total * WIDTH), WIDTH - 1)
+            host_s = ev.get("host_s")
+            if ev["name"] == "prefill_slice" and host_s is not None:
+                # overlapped-prefill slice: render its host wall as a ▒ bar
+                # ENDING at the event timestamp (slices stamp their event
+                # after dispatch), so back-to-back slices visibly tile the
+                # prefill span — the overlap picture the round-6 pipeline
+                # exists for.  Label carries offset/tokens.
+                lo = max(0, min(int((at - host_s) / total * WIDTH), mark))
+                sbar = (" " * lo + "▒" * max(mark - lo + 1, 1)
+                        + " " * (WIDTH - mark - 1))[:WIDTH]
+                ename = (" " * ((depth + 1) * INDENT)
+                         + f"* slice@{ev.get('offset', '?')}")[:NAME_COL]
+                lines.append(f"{ename:<{NAME_COL}} {_fmt_ms(at - host_s)} "
+                             f"{_fmt_ms(host_s)} |{sbar}|"
+                             f"  n={ev.get('tokens', '?')}")
+                continue
             tick = " " * mark + "▲" + " " * (WIDTH - mark - 1)
             ename = (" " * ((depth + 1) * INDENT) + "* " + ev["name"])[:NAME_COL]
             lines.append(f"{ename:<{NAME_COL}} {_fmt_ms(at)} {'':>6} |{tick}|")
