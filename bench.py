@@ -602,6 +602,119 @@ def ttft_sweep_main() -> None:
             print(json.dumps(line), flush=True)
 
 
+def replay_main() -> None:
+    """``python bench.py --multiturn-replay`` (env: LFKT_BENCH_REPLAY=1):
+    the block-paged radix prefix cache's payoff measurement —
+    ``LFKT_BENCH_CONVS`` conversations sharing one system prompt, each
+    replayed for ``LFKT_BENCH_TURNS`` turns through a serial engine with
+    ``LFKT_KV_PAGED=1`` (parallel/kvpool.py).  Emits ONE JSON line:
+    warm-turn TTFT p50 (prefix hit) vs cold p50 (full prefill), the
+    prefix hit ratio, and the pool's event counters/occupancy — the
+    artifact that shows warm-turn prefill work reduced by the matched
+    prefix length.
+
+    Runs against a synthesized tiny GGUF by default (CPU smoke,
+    ``tests/test_bench_entrypoints.py``); point ``LFKT_BENCH_REPLAY_GGUF``
+    at a real model file for chip sessions.
+    """
+    import statistics
+    import tempfile
+
+    import jax
+
+    from llama_fastapi_k8s_gpu_tpu.utils.config import force_cpu_if_requested
+
+    force_cpu_if_requested()
+
+    from llama_fastapi_k8s_gpu_tpu.utils.jaxcache import setup_compile_cache
+
+    setup_compile_cache()
+
+    from llama_fastapi_k8s_gpu_tpu.engine import Engine
+    from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+    from llama_fastapi_k8s_gpu_tpu.testing import (
+        TINY_CFG,
+        write_tiny_llama_gguf,
+    )
+
+    preset = os.environ.get("LFKT_BENCH_PRESET", "tiny")
+    n_convs = int(os.environ.get("LFKT_BENCH_CONVS", "3"))
+    n_turns = int(os.environ.get("LFKT_BENCH_TURNS", "4"))
+    max_tokens = int(os.environ.get("LFKT_BENCH_MAX_TOKENS", "12"))
+    n_ctx = int(os.environ.get("LFKT_BENCH_NCTX", "512"))
+    page_tokens = int(os.environ.get("LFKT_BENCH_PAGE_TOKENS", "16"))
+    pool_pages = int(os.environ.get("LFKT_BENCH_POOL_PAGES", "0"))
+    spill_pages = int(os.environ.get("LFKT_BENCH_SPILL_PAGES", "32"))
+    gguf = os.environ.get("LFKT_BENCH_REPLAY_GGUF", "")
+    if not gguf:
+        gguf = os.path.join(tempfile.mkdtemp(prefix="lfkt-replay-"),
+                            "tiny.gguf")
+        write_tiny_llama_gguf(gguf, cfg=ModelConfig(
+            **{**TINY_CFG.__dict__, "n_ctx": n_ctx}))
+
+    dev = jax.devices()[0]
+    print(f"{_INIT_MARK} {dev}", file=sys.stderr, flush=True)
+
+    eng = Engine(gguf, n_ctx=n_ctx, decode_chunk=8,
+                 max_gen_tokens=max_tokens,
+                 prefill_buckets=(64, 128, 256, 512),
+                 prefill_chunk=max(16, page_tokens),
+                 kv_paged=True, kv_page_tokens=page_tokens,
+                 kv_pool_pages=pool_pages, kv_spill_pages=spill_pages,
+                 prefix_min=page_tokens)
+    eng.warmup()
+    stats0 = eng._kvpool.stats()     # warmup's own commits/misses excluded
+
+    system = {"role": "system",
+              "content": "You are a helpful, careful assistant who answers "
+                         "briefly and precisely. " * 2}
+    calls = []                       # (conv, turn, ttft_s, reused_tokens)
+    for c in range(n_convs):
+        msgs = [system,
+                {"role": "user", "content": f"Conversation {c}: first ask."}]
+        for t in range(n_turns):
+            r = eng.create_chat_completion(msgs, temperature=0.0,
+                                           max_tokens=max_tokens)
+            tm = r["lfkt_timings"]
+            calls.append((c, t, tm["ttft_s"], tm["prefix_reused_tokens"]))
+            msgs = msgs + [
+                {"role": "assistant",
+                 "content": r["choices"][0]["message"]["content"]},
+                {"role": "user", "content": f"Follow-up {t} of chat {c}."}]
+
+    stats1 = eng._kvpool.stats()
+    delta = {k: stats1[k] - stats0.get(k, 0) for k in stats1}
+    consulted = delta["hits"] + delta["misses"]
+    warm = sorted(ttft for _c, _t, ttft, reused in calls if reused > 0)
+    cold = sorted(ttft for _c, _t, ttft, reused in calls if reused == 0)
+    p50 = (lambda xs: statistics.median(xs) * 1000.0 if xs else 0.0)
+    line = {
+        # warm-turn TTFT is THE number multi-turn traffic feels; hit
+        # ratio/reused tokens attribute it to the radix cache
+        "metric": f"warm_ttft_ms_p50[kv-paged-replay,{preset}]",
+        "value": round(p50(warm), 1),
+        "unit": "ms",
+        "vs_baseline": 0.0,          # informational; no A10G analogue
+        "cold_ttft_ms_p50": round(p50(cold), 1),
+        "warm_turns": len(warm),
+        "cold_turns": len(cold),
+        "prefix_hit_ratio": round(delta["hits"] / consulted, 3)
+        if consulted else 0.0,
+        "reused_tokens_total": delta["reused_tokens"],
+        "conversations": n_convs,
+        "turns_per_conversation": n_turns,
+        "page_tokens": page_tokens,
+        "pool": eng.kv_pool_occupancy(),
+        "pool_events": delta,
+        "per_turn": [
+            {"conv": c, "turn": t, "ttft_ms": round(ttft * 1000.0, 1),
+             "reused_tokens": reused}
+            for c, t, ttft, reused in calls],
+        "device": str(dev),
+    }
+    print(json.dumps(line), flush=True)
+
+
 def child_main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -610,6 +723,9 @@ def child_main() -> None:
         return
     if os.environ.get("LFKT_BENCH_TTFT_SWEEP") == "1":
         ttft_sweep_main()
+        return
+    if os.environ.get("LFKT_BENCH_REPLAY") == "1":
+        replay_main()
         return
 
     import jax
@@ -984,6 +1100,8 @@ def main() -> None:
     if "--ttft-sweep" in sys.argv[1:]:
         # flag → env so the watchdog-spawned child (argument-less) sees it
         os.environ["LFKT_BENCH_TTFT_SWEEP"] = "1"
+    if "--multiturn-replay" in sys.argv[1:]:
+        os.environ["LFKT_BENCH_REPLAY"] = "1"
     if os.environ.get("LFKT_BENCH_CHILD") == "1":
         child_main()
         return
@@ -1033,14 +1151,23 @@ def main() -> None:
         if not retriable:
             break
 
-    preset = os.environ.get("LFKT_BENCH_PRESET", "llama3-8b")
-    wfmt = os.environ.get("LFKT_BENCH_FMT", "q4km")
     sweep = os.environ.get("LFKT_BENCH_TTFT_SWEEP") == "1"
+    replay = os.environ.get("LFKT_BENCH_REPLAY") == "1"
+    # replay's child defaults to the tiny synthetic preset; the failure
+    # line must carry the SAME metric name a success would
+    preset = os.environ.get("LFKT_BENCH_PRESET",
+                            "tiny" if replay else "llama3-8b")
+    wfmt = os.environ.get("LFKT_BENCH_FMT", "q4km")
+    if replay:
+        metric = f"warm_ttft_ms_p50[kv-paged-replay,{preset}]"
+    elif sweep:
+        metric = f"ttft_ms_p50[ttft-sweep,{preset},{wfmt}]"
+    else:
+        metric = f"decode_tokens_per_sec_per_chip[{preset},{wfmt},synthetic]"
     print(json.dumps({
-        "metric": (f"ttft_ms_p50[ttft-sweep,{preset},{wfmt}]" if sweep else
-                   f"decode_tokens_per_sec_per_chip[{preset},{wfmt},synthetic]"),
+        "metric": metric,
         "value": 0.0,
-        "unit": "ms" if sweep else "tokens/sec/chip",
+        "unit": "ms" if sweep or replay else "tokens/sec/chip",
         "vs_baseline": 0.0,
         "error": f"{len(errors)} attempt(s) failed; last: {errors[-1][:500]}",
         "attempts": len(errors),

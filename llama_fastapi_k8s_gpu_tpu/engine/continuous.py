@@ -301,9 +301,27 @@ class ContinuousEngine(MeshEngine):
         # the shared batched program, but those writes land at positions
         # past the claim (clamping to slot n_ctx-1 once pos overruns).
         self._lane_prefix = bool(lane_prefix_cache) and not self._spec_draft
+        # paged mode (LFKT_KV_PAGED) folds the lane claims behind the
+        # shared radix tree: one prefix-reuse implementation per mode (the
+        # per-lane claim path remains the dense-ring default).  An
+        # admission's reuse must stay aligned to BOTH the prefill slice
+        # (every suffix slice shape inside the warmed compiled set) and
+        # the page size (pages are the restore grain) — the lcm below.
+        if self._kv_paged:
+            self._lane_prefix = False
+            import math
+
+            self._paged_align = math.lcm(self._prefill_chunk,
+                                         self._kvpool.page_tokens)
         self._lane_claims: list[list | None] = [None] * self.batch_size
-        self._prefix_stats = {"lane_prefix_hits": 0,
-                              "lane_prefix_reused_tokens": 0}
+        #: realized admission reuse, named for the implementation that
+        #: served it — "lane_prefix" (dense claims) or "radix_prefix"
+        #: (paged pool) — so a paged-vs-dense A/B never shows phantom
+        #: activity under the other mode's stat
+        self._reuse_stat = "radix_prefix" if self._kv_paged \
+            else "lane_prefix"
+        self._prefix_stats = {f"{self._reuse_stat}_hits": 0,
+                              f"{self._reuse_stat}_reused_tokens": 0}
         self._scratch_cache = init_cache(self.cfg)
         base_st = sampling_tensors(SamplingParams())
         self._lane_st = jax.tree.map(
@@ -596,12 +614,13 @@ class ContinuousEngine(MeshEngine):
     def _free_lane(self, lane: int, slot: _Slot, slots: list,
                    claim: bool = True) -> None:
         """Release ``slot``'s lane (no-op if it never occupied one) and
-        record which token ids' KV remain valid there for lane-prefix
-        reuse.  The ONE place the free-lane invariant lives — every path
-        that finishes a slot must come through here.  ``claim=False`` for
-        error finishes (a device fault surfaced at fetch means the KV that
-        prefill left in the lane is of unknown validity — it must not seed
-        a later admission's reuse).
+        record which token ids' KV remain valid there for prefix reuse —
+        a lane claim in dense mode, a pool commit in paged mode.  The ONE
+        place the free-lane invariant lives — every path that finishes a
+        slot must come through here.  ``claim=False`` for error finishes
+        (a device fault surfaced at fetch means the KV that prefill left
+        in the lane is of unknown validity — it must not seed a later
+        admission's reuse).
 
         Claim residency matches the serial engine's prefix cache
         (engine.py::_finish): ring slots [0, n_prompt + len(gens) - 1)
@@ -610,13 +629,24 @@ class ContinuousEngine(MeshEngine):
         past that (capped at n_ctx-1 where overrun writes clamp)."""
         if slots[lane] is slot:
             slots[lane] = None
+        keep = min(slot.n_prompt + max(len(slot.gens) - 1, 0),
+                   self.cfg.n_ctx - 1)
+        if self._kv_paged:
+            if claim:
+                # commit the finished conversation's whole-page prefix to
+                # the shared pool straight from the batched lane (the
+                # gather+slice+scatter fuse in one program — no lane-ring
+                # copy is materialized); already-cached pages dedupe, so a
+                # multi-turn follow-up stores only its delta
+                self._kvpool.commit_lane(
+                    (list(slot.ids) + slot.gens)[:keep],
+                    self._bstate["cache"], lane)
+            return
         if not self._lane_prefix:
             return
         if not claim:
             self._lane_claims[lane] = None
             return
-        keep = min(slot.n_prompt + max(len(slot.gens) - 1, 0),
-                   self.cfg.n_ctx - 1)
         self._lane_claims[lane] = (list(slot.ids) + slot.gens)[:keep]
 
     def _find_lane_reuse(self, ids: list, n_prompt: int):
@@ -676,6 +706,7 @@ class ContinuousEngine(MeshEngine):
             # pending: submit -> the scheduler picking this item up
             item.trace.span("pending", t0=item.t_enq).end(t0)
             pspan = item.trace.span("prefill", t0=t0)
+        lease = None
         try:
             ids = self.tokenize_messages(item.messages)
             if len(ids) >= self.cfg.n_ctx:
@@ -684,12 +715,29 @@ class ContinuousEngine(MeshEngine):
                     f"of {self.cfg.n_ctx}")
             bucket = self._bucket_for(len(ids))
             reuse, src = 0, None
-            if self._lane_prefix and item.seed is None:
+            if item.seed is None:
                 # explicit seeds take the full prefill: the suffix pass
                 # scores bf16-rounded reused KV, so a near-tied logit could
                 # flip — same reproducibility contract as the serial engine
-                reuse, src = self._find_lane_reuse(ids, len(ids))
-            if reuse:
+                if self._kv_paged:
+                    reuse, lease = self._paged_admission_reuse(ids, pspan)
+                elif self._lane_prefix:
+                    reuse, src = self._find_lane_reuse(ids, len(ids))
+            if lease is not None:
+                # restore the matched pages straight into the scratch ring
+                # (donated in place — no transient second ring, unlike the
+                # lane snapshot below); the suffix slices then prefill
+                # from offset ``reuse`` exactly like a lane-claim hit.
+                # The scratch ref is dropped across the donating call: a
+                # mid-copy failure must not leave a dead donated buffer
+                # as self._scratch_cache (_dispatch_prefill_chunk
+                # re-creates on None, same as the lane-snapshot path)
+                scratch, self._scratch_cache = self._scratch_cache, None
+                if scratch is None:
+                    scratch = init_cache(self.cfg)
+                self._scratch_cache = self._kvpool.restore(
+                    lease, scratch, span=pspan)
+            elif reuse:
                 # snapshot the source lane's ring as this admission's
                 # scratch; the functional gather captures the lane BEFORE
                 # any later decode writes, so the claim region is stable.
@@ -720,15 +768,44 @@ class ContinuousEngine(MeshEngine):
                 "st": sampling_tensors(item.sp),
                 "seed": item.seed if item.seed is not None else self._next_seed(),
                 "t0": t0, "offset": reuse, "reused": reuse, "logits": None,
-                "span": pspan,
+                "span": pspan, "lease": lease,
             }
         except Exception as e:  # noqa: BLE001 — per-request isolation
             self._note_error(e)
+            if lease is not None:
+                self._kvpool.release(lease)
             if item.future is not None:
                 item.future.set_exception(e)
             elif item.sink is not None:
                 item.sink.put(e)
             return None
+
+    def _paged_admission_reuse(self, ids: list, pspan=None):
+        """(reuse_tokens, lease | None): the longest cached whole-page
+        prefix aligned to ``_paged_align``, pinned.  No bucket constraint
+        (admissions prefill in slices from the reuse offset) — the same
+        cap and alignment contract as :meth:`_find_lane_reuse`, against
+        the process-wide radix index instead of per-lane claims."""
+        pool = self._kvpool
+        i = min(pool.match_len(ids), len(ids) - 1)
+        r = (i // self._paged_align) * self._paged_align
+        if r < self._paged_align:
+            pool.note_miss()
+            return 0, None
+        lease = pool.acquire(ids, r, span=pspan)
+        if lease is None:      # raced an eviction / spill-restore failed
+            return 0, None
+        if pspan is not None:
+            pspan.set(reused_pages=len(lease.page_ids), matched_tokens=i)
+        return r, lease
+
+    def _release_adm_lease(self, adm) -> None:
+        """Unpin an admission's pool pages (idempotent: the lease is
+        consumed from the machine dict) — called from every admission
+        exit: finish, abandon, dispatch failure."""
+        lease = adm.pop("lease", None) if adm else None
+        if lease is not None:
+            self._kvpool.release(lease)
 
     def _dispatch_prefill_chunk(self, adm: dict) -> None:
         """Run ONE prompt slice through the model into the scratch cache.
@@ -800,8 +877,9 @@ class ContinuousEngine(MeshEngine):
             slot.pspan = adm.get("span")
             slot.reused = adm.get("reused", 0)
             if slot.reused:     # count only realized reuse (lane written)
-                self._prefix_stats["lane_prefix_hits"] += 1
-                self._prefix_stats["lane_prefix_reused_tokens"] += slot.reused
+                self._prefix_stats[f"{self._reuse_stat}_hits"] += 1
+                self._prefix_stats[
+                    f"{self._reuse_stat}_reused_tokens"] += slot.reused
             if any(s is not None for s in slots):
                 try:
                     token.copy_to_host_async()
@@ -827,6 +905,11 @@ class ContinuousEngine(MeshEngine):
                 item.future.set_exception(e)
             elif item.sink is not None:
                 item.sink.put(e)
+        finally:
+            # the lease's job ends once the restored scratch has been
+            # written into the lane (or the admission failed): unpin so
+            # the pages become evictable again
+            self._release_adm_lease(adm)
 
     def _end_prefill_span(self, slot: _Slot) -> None:
         """Close the admission's ``prefill`` span at TTFT.  Idempotent —
@@ -1013,6 +1096,7 @@ class ContinuousEngine(MeshEngine):
         if adm["item"].abandoned.is_set():       # caller gave up mid-prefill
             if adm.get("span") is not None:
                 adm["span"].set(abandoned=True).end()
+            self._release_adm_lease(adm)
             self._resolve_skipped(adm["item"])
             self._adm = None
             return 0
@@ -1022,6 +1106,7 @@ class ContinuousEngine(MeshEngine):
         except Exception as e:  # noqa: BLE001 — per-request isolation: a
             item = adm["item"]  # failed admission must not kill the scheduler
             self._adm = None
+            self._release_adm_lease(adm)
             self._note_error(e)
             if adm.get("span") is not None:
                 adm["span"].set(error=str(e)).end()
@@ -1078,7 +1163,7 @@ class ContinuousEngine(MeshEngine):
         the reference's single queue-depth number.  Written once per loop
         iteration; reads are a dict swap, no lock needed."""
         out = {"batch_size": self.batch_size, **self._stats}
-        if self._lane_prefix:
+        if self._lane_prefix or self._kv_paged:
             out.update(self._prefix_stats)
         if self._spec_draft:
             out["spec"] = dict(self._spec_stats)
@@ -1342,6 +1427,7 @@ class ContinuousEngine(MeshEngine):
             err = self._loop_error or RuntimeError("engine has been shut down")
             if self._adm is not None:       # admission mid-prefill: resolve it
                 item = self._adm["item"]
+                self._release_adm_lease(self._adm)
                 self._adm = None
                 if item.sink is not None:
                     item.sink.put(err if self._loop_error else _STREAM_END)

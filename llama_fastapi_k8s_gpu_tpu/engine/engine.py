@@ -126,6 +126,7 @@ class Engine:
     _GUARDED_BY = {
         "_cache": "_lock",
         "_prefix_ids": "_lock",
+        "_paged_lease": "_lock",
         "_requests": "_id_lock",
         "last_timings": "_id_lock",
     }
@@ -135,6 +136,11 @@ class Engine:
     #: the sequence-parallel engine (engine/sp.py) overrides this to False
     #: and keeps its rerouted monolithic ring prefill.
     _SLICE_PREFILL = True
+
+    #: whether this engine can serve the block-paged KV pool
+    #: (LFKT_KV_PAGED): page restore/store slice the ring's n_ctx dim,
+    #: which must be unsharded — engine/sp.py overrides to False.
+    _KV_PAGED = True
 
     def __init__(
         self,
@@ -159,6 +165,12 @@ class Engine:
         #                             serial overlapped bucket slices
         prefill_overlap: int = 2,   # un-synced prefill slices in flight
         #                             (0 = monolithic bucket prefill)
+        kv_paged: bool = False,     # block-paged KV pool + radix prefix
+        #                             cache (parallel/kvpool.py); the dense
+        #                             ring stays the default A/B control
+        kv_page_tokens: int = 128,  # token slots per pool page
+        kv_pool_pages: int = 0,     # pool size in pages (0 = auto)
+        kv_spill_pages: int = 0,    # host-RAM spill tier capacity (0 = off)
         *,
         _parts: tuple | None = None,  # (params, cfg, tokenizer, template_kind)
     ):
@@ -351,6 +363,37 @@ class Engine:
         #: token ids whose KV occupy ring slots [0, len) — only ever read
         #: and written under self._lock (the single-generator invariant)
         self._prefix_ids: list[int] = []
+        # -- block-paged KV pool + shared radix prefix index (ROADMAP item
+        # 2; gated behind LFKT_KV_PAGED, dense ring is the A/B control) ----
+        # One prefix-reuse implementation per mode: paging replaces the
+        # serial single-claim above (and the continuous engine's lane
+        # claims) with the process-wide radix index — shared system
+        # prompts prefill once per process, multi-turn requests resume
+        # from their last committed page.  Spec decode keeps the same
+        # exclusion as every reuse path (verify rounds leave rejected
+        # drafts in cache slots, and reuse would break spec's same-seed
+        # determinism contract).
+        paged = bool(kv_paged) and not self._spec_draft
+        if paged and not self._KV_PAGED:
+            logger.warning(
+                "LFKT_KV_PAGED=1 requested but %s shards the ring's n_ctx "
+                "dim; the paged pool needs it unsharded — serving with the "
+                "dense ring", type(self).__name__)
+            paged = False
+        self._kv_paged = paged
+        #: the in-flight request's pinned pool pages (exactly one live
+        #: lease: the serial engines generate one request at a time)
+        self._paged_lease = None
+        if paged:
+            from ..parallel.kvpool import KVPool
+
+            self._prefix_cache = False
+            self._kvpool = KVPool(
+                self.cfg, page_tokens=kv_page_tokens,
+                n_pages=kv_pool_pages, spill_pages=kv_spill_pages,
+                sink_host=self)
+        else:
+            self._kvpool = None
 
     # ------------------------------------------------------------------
     @property
@@ -367,7 +410,19 @@ class Engine:
                       (getattr(self, "_bstate", None) or {}).get("cache")):
             if cache is not None:
                 total += sum(leaf.nbytes for leaf in jax.tree.leaves(cache))
+        pool = getattr(self, "_kvpool", None)
+        if pool is not None:
+            total += pool.arena_nbytes
         return total
+
+    def kv_pool_occupancy(self) -> dict | None:
+        """Paged-pool occupancy + event counters — the /health ``kv_pool``
+        block and the ``kv_pool_pages_{used,free}`` gauges; None when
+        ``LFKT_KV_PAGED`` is off."""
+        pool = getattr(self, "_kvpool", None)
+        if pool is None:
+            return None
+        return {**pool.occupancy(), **pool.stats()}
 
     # ------------------------------------------------------------------
     @classmethod
@@ -460,13 +515,16 @@ class Engine:
                     [0] * (b - 1), b - 1, b, self._cache)
                 jax.block_until_ready(logits)
                 self._cache = cache
-            if self._prefix_cache:
+            if self._prefix_cache or self._kv_paged:
                 # compile the suffix pass for every bucket a reuse suffix can
                 # land in (all but the largest — _prefix_reuse_len only grants
                 # reuse when the suffix bucket is strictly smaller than the
-                # prompt's), preserving the no-cold-compile-after-warmup
+                # prompt's; the paged radix path shares the same suffix-bucket
+                # contract), preserving the no-cold-compile-after-warmup
                 # invariant on the reuse path too.  Also drops the claim over
                 # the garbage the raw bucket loop above wrote into the ring.
+                # (Pool page-copy programs are NOT part of this warmed set:
+                # they compile on first use — parallel/kvpool.py.)
                 for b in self.prefill_buckets[:-1]:
                     logits, self._cache = prefill_chunk_jit(
                         self.params, self.cfg, jnp.zeros((b,), jnp.int32),
@@ -597,6 +655,11 @@ class Engine:
         """Engine-specific state re-init, called with the lock held."""
         self._cache = init_cache(self.cfg)
         self._prefix_ids = []
+        if self._kvpool is not None:
+            # lane/ring contents are of unknown validity after a trip —
+            # nothing resident (or pinned) is trustworthy
+            self._drop_lease()
+            self._kvpool.reset()
 
     @staticmethod
     def _deadline_hit(ctx) -> bool:
@@ -708,6 +771,12 @@ class Engine:
         pspan = None
         if espan is not None:
             pspan = espan.child("prefill", t0=t0)
+        if self._kv_paged and not explicit_seed:
+            # paged mode: the shared radix index replaces the single-claim
+            # reuse above (restores matched pages into the ring and pins
+            # them for this request — parallel/kvpool.py)
+            reuse = self._paged_reuse(ids, n_prompt, bucket, pspan)
+        if pspan is not None:
             pspan.set(n_prompt=n_prompt, bucket=bucket, reused=reuse)
         # claim nothing while this request is in flight: an exception past
         # this point must not leave a stale prefix claim over a cache whose
@@ -776,13 +845,75 @@ class Engine:
                 return r
         return 0
 
+    def _drop_lease(self) -> None:  # lfkt: holds[_lock]
+        """Unpin the current request's pool pages (idempotent)."""
+        if self._paged_lease is not None:
+            self._kvpool.release(self._paged_lease)
+            self._paged_lease = None
+
+    def _paged_reuse(self, ids: list, n_prompt: int, bucket: int,
+                     pspan=None) -> int:  # lfkt: holds[_lock]
+        """Radix-tree prefix reuse (LFKT_KV_PAGED): the longest cached
+        whole-page prefix that fits the suffix-bucket contract (exactly
+        :meth:`_prefix_reuse_len`'s constraints, page-aligned), restored
+        contiguously into the ring and pinned for the request's lifetime.
+        Returns the reused token count (0 = full prefill)."""
+        self._drop_lease()   # a prior request's exception may have leaked
+        pool = self._kvpool
+        T = pool.page_tokens
+        i = min(pool.match_len(ids), n_prompt - 1)
+        r_fit = 0
+        # same clamp as _prefix_reuse_len: the padded suffix slice
+        # [reuse, reuse + sbucket) must stay inside the ring, and the
+        # suffix must land in a strictly smaller bucket — plus page
+        # alignment, since pages are the restore grain
+        for b in self.prefill_buckets:
+            if b >= bucket:
+                break
+            r = (min(i, self.cfg.n_ctx - b) // T) * T
+            if r >= max(self._prefix_min, T) and n_prompt - r <= b:
+                r_fit = r
+                break
+        if r_fit == 0:
+            pool.note_miss()
+            return 0
+        lease = pool.acquire(ids, r_fit, span=pspan)
+        if lease is None:    # raced an eviction / spill-restore failed
+            return 0
+        self._paged_lease = lease
+        # the ring is donated into the copy: drop our ref across the call
+        # so a mid-copy failure cannot leave a dead donated buffer as
+        # self._cache (the next request would trip over it) — rebuild
+        # cold instead, exactly like _reinit, and propagate
+        cache, self._cache = self._cache, None
+        try:
+            self._cache = pool.restore(lease, cache, span=pspan)
+        except Exception:
+            self._drop_lease()
+            self._cache = init_cache(self.cfg)
+            raise
+        if pspan is not None:
+            pspan.set(reused_pages=len(lease.page_ids), matched_tokens=i)
+        return lease.tokens
+
     def _finish(self, ctx) -> dict:  # lfkt: holds[_lock]
         """Return the cache buffer for reuse; finalize per-phase timings.
         Returns the timings dict (also published to :attr:`last_timings`)."""
         self._cache = ctx["state"]["cache"]
         decode_s = time.time() - ctx["t0"] - ctx["ttft_s"]
         n = len(ctx["ids"])
-        if self._prefix_cache:
+        if self._kv_paged:
+            # commit the conversation's whole-page prefix to the shared
+            # pool (pages already cached are deduplicated, so a multi-turn
+            # follow-up stores only its delta) and unpin this request's
+            # lease.  Ring residency is the same claim as below: slots
+            # [0, n_prompt + n - 1) hold prompt + generated tokens except
+            # the last sampled one.
+            keep = ctx["n_prompt"] + max(n - 1, 0)
+            self._kvpool.commit((ctx["prompt_ids"] + ctx["ids"])[:keep],
+                                self._cache, span=ctx.get("span"))
+            self._drop_lease()
+        elif self._prefix_cache:
             # ring slots [0, n_prompt + n - 1) now hold prompt + all
             # generated tokens except the last sampled one (its KV write
             # happens only when it is fed — which a finished request never

@@ -42,6 +42,12 @@ class SPEngine(Engine):
     #: (sp_prefill), so it keeps monolithic bucket prefill.
     _SLICE_PREFILL = False
 
+    #: the paged KV pool (LFKT_KV_PAGED, parallel/kvpool.py) slices and
+    #: updates the ring's n_ctx dim, which this engine shards over the sp
+    #: axis — paging stays off (Engine.__init__ warns and serves the
+    #: dense sharded ring; greedy output is identical either way).
+    _KV_PAGED = False
+
     def __init__(self, model_path: str | None, *, sp: int = 2, tp: int = 1,
                  n_ctx: int = 4096, **kw):
         if sp < 2:

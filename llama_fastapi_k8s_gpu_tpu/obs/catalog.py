@@ -32,6 +32,9 @@ LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 RATE_BUCKETS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0)
 #: batch occupancy buckets (lanes filled per cycle)
 OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+#: token-count buckets (prefix reuse lengths: one page up to a 32k prompt)
+TOKEN_BUCKETS = (64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0,
+                 8192.0, 16384.0, 32768.0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +105,22 @@ METRICS: dict[str, Metric] = _register(
            "requests served with prompt-prefix KV reuse"),
     Metric("prefix_cache_reused_tokens_total", COUNTER,
            "prompt tokens NOT re-prefilled thanks to prefix reuse"),
+    # -- block-paged KV pool + radix prefix cache (parallel/kvpool.py) -----
+    Metric("prefix_cache_misses_total", COUNTER,
+           "requests that consulted the radix prefix index and took a "
+           "full prefill (no usable cached prefix)"),
+    Metric("prefix_cache_evictions_total", COUNTER,
+           "KV pool nodes evicted (LRU, unpinned) to free pages"),
+    Metric("prefix_cache_spills_total", COUNTER,
+           "evicted KV nodes DMA'd to the host-RAM spill tier"),
+    Metric("prefix_cache_restores_total", COUNTER,
+           "spilled KV nodes restored to HBM on a prefix hit"),
+    Metric("prefix_reuse_tokens", HISTOGRAM,
+           "per-hit prompt tokens served from cached KV pages",
+           buckets=TOKEN_BUCKETS),
+    Metric("kv_pool_pages_used", GAUGE,
+           "KV pool pages holding indexed cache content"),
+    Metric("kv_pool_pages_free", GAUGE, "KV pool pages on the free list"),
     # -- prefill pipeline (overlapped chunked prefill + admission control) --
     Metric("prefill_slice_seconds", HISTOGRAM,
            "host wall of one prefill-slice dispatch (prep + enqueue; "
@@ -139,7 +158,8 @@ METRICS: dict[str, Metric] = _register(
     Metric("scheduler_", GAUGE,
            "continuous-scheduler occupancy family "
            "(ContinuousEngine.scheduler_stats: lanes_live, pending, "
-           "admission_inflight, spec_*, lane_prefix_*)", prefix=True),
+           "admission_inflight, spec_*, lane_prefix_* / radix_prefix_*)",
+           prefix=True),
 )
 
 

@@ -25,6 +25,18 @@ FLOPs), at the cost of ``kv_unroll``× the K/V VMEM residency per step.
 ``LFKT_FLASH_KV_UNROLL`` sets the default; the causal classifier still
 skips/interior-specializes per sub-block, so a fused block pays VPU mask
 work only for the sub-blocks that need it.
+
+Paged-KV contract (``LFKT_KV_PAGED``, parallel/kvpool.py): the pool is
+**page-contiguous**, not gathered — a radix-cache hit copies its pages
+into the FRONT of an ordinary dense ring before prefill, so this kernel
+always sees the same head-major ``(n_kv, n_ctx, hd)`` ring it was probed
+and tuned for, with no page-table indirection in the block index maps
+(the KER001-003 contract is unchanged, and paged greedy decode stays
+bit-identical to dense).  A gathered variant — per-block page-id
+prefetch feeding the K/V index maps — only pays once pages stop being
+materialized locally, i.e. the disaggregated-prefill step (ROADMAP item
+6) where the page pytree becomes the wire format; grow it from the
+``kv_unroll`` block loop here when that lands.
 """
 
 from __future__ import annotations

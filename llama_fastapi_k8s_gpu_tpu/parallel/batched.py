@@ -21,6 +21,12 @@ from ..sampling.sample import PENALTY_WINDOW, sample_chain
 
 
 def init_batched_state(cfg: ModelConfig, batch: int, seed: int = 0) -> dict:
+    """Batched generation state: every per-sequence leaf grows a leading
+    ``batch`` dim.  The cache leaves' token axis therefore sits at axis 3
+    — the paged KV pool's lane-store op (parallel/kvpool.py
+    ``_store_lane_pages_jit``) indexes the batch dim away and slices that
+    axis directly out of this layout, so a freed lane's conversation is
+    committed to the pool without ever materializing a lane-ring copy."""
     cache = init_cache(cfg)
     return {
         "cache": jax.tree.map(lambda x: jnp.broadcast_to(x, (batch,) + x.shape), cache),

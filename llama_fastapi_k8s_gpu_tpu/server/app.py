@@ -816,6 +816,13 @@ def create_app(engine=None, settings: Settings | None = None,
                 "kv_dtype": getattr(cfg, "kv_dtype", None),
                 "kv_cache_bytes": getattr(eng, "kv_cache_bytes", None),
             }
+            # paged KV pool occupancy (LFKT_KV_PAGED): pages used/free/
+            # pinned, the spill tier, and the hit/eviction counters —
+            # the "is my pool sized right" answer next to kv_cache_bytes
+            # (docs/RUNBOOK.md "Sizing the KV page pool")
+            occ = getattr(eng, "kv_pool_occupancy", None)
+            if callable(occ):
+                engine_info["kv_pool"] = occ()
             # spec_decode="auto": the measured-RTT decision and its inputs
             # (engine/spec_auto.py) — operators verify the resolution here
             if getattr(eng, "spec_auto_decision", None) is not None:
@@ -845,6 +852,14 @@ def create_app(engine=None, settings: Settings | None = None,
         kv_bytes = getattr(app.state.engine, "kv_cache_bytes", None)
         if kv_bytes is not None:
             m.set_gauge("kv_cache_bytes", kv_bytes)
+        # paged KV pool occupancy gauges (the event counters —
+        # misses/evictions/spills/restores + the reuse histogram — are
+        # inc'd at event time by the pool through the injected sink)
+        occ = getattr(app.state.engine, "kv_pool_occupancy", None)
+        pool = occ() if callable(occ) else None
+        if pool is not None:
+            m.set_gauge("kv_pool_pages_used", pool["pages_used"])
+            m.set_gauge("kv_pool_pages_free", pool["pages_free"])
         stats = getattr(app.state.engine, "scheduler_stats", None)
         if stats is not None:
             snap = stats()
@@ -984,6 +999,10 @@ def _default_engine_factory(settings: Settings):
             prefix_cache=settings.prefix_cache,
             prefill_chunk=settings.prefill_chunk,
             prefill_overlap=settings.prefill_overlap,
+            kv_paged=settings.kv_paged,
+            kv_page_tokens=settings.kv_page_tokens,
+            kv_pool_pages=settings.kv_pool_pages,
+            kv_spill_pages=settings.kv_spill_pages,
         )
         if settings.scheduler not in ("continuous", "cycle"):
             raise ValueError(

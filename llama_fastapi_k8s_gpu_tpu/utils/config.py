@@ -140,6 +140,20 @@ class Settings:
     # gap (round 6); explicit-seed requests still bypass it (the
     # reproducibility contract) and spec decode still excludes it.
     lane_prefix_cache: bool = True
+    # block-paged KV pool + shared radix-tree prefix cache
+    # (parallel/kvpool.py; docs/RUNBOOK.md "Sizing the KV page pool"):
+    # KV pages live in one preallocated arena fronted by a radix tree
+    # keyed on token prefixes, so shared system prompts prefill once per
+    # process and multi-turn requests resume from their last committed
+    # page regardless of lane.  OFF by default — the dense per-lane ring
+    # stays the A/B control (greedy decode is bit-identical either way,
+    # pinned by tests/test_kv_paged_engines.py).
+    kv_paged: bool = False
+    kv_page_tokens: int = 128       # token slots per pool page
+    kv_pool_pages: int = 0          # arena size in pages (0 = auto:
+    #                                 4 full contexts' worth)
+    kv_spill_pages: int = 0         # host-RAM spill tier capacity in
+    #                                 pages (0 = evictions discard)
     prefill_chunk: int = 256        # prefill slice size: the continuous
     #                                 scheduler's admission slices AND the
     #                                 serial engine's overlapped bucket
@@ -263,6 +277,12 @@ KNOBS: dict[str, Knob] = _register(
     Knob("LFKT_SPEC_DRAFT", int, "draft tokens per verify step"),
     Knob("LFKT_PREFIX_CACHE", bool, "serial-engine prompt-prefix KV reuse"),
     Knob("LFKT_LANE_PREFIX_CACHE", bool, "lane-claim admission KV reuse"),
+    Knob("LFKT_KV_PAGED", bool,
+         "block-paged KV pool + radix-tree prefix cache (0 = dense ring)"),
+    Knob("LFKT_KV_PAGE_TOKENS", int, "token slots per KV pool page"),
+    Knob("LFKT_KV_POOL_PAGES", int, "KV pool arena size in pages (0 = auto)"),
+    Knob("LFKT_KV_SPILL_PAGES", int,
+         "host-RAM KV spill tier capacity in pages (0 = off)"),
     Knob("LFKT_PREFILL_CHUNK", int, "prefill slice tokens (admission + "
          "serial overlapped prefill)"),
     Knob("LFKT_PREFILL_OVERLAP", int,

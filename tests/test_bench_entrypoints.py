@@ -132,6 +132,25 @@ def test_bench_ttft_sweep_tiny_smoke():
         assert len(p["samples_ms"]) == 5
 
 
+def test_bench_multiturn_replay_tiny_smoke():
+    """--multiturn-replay (LFKT_BENCH_REPLAY=1): the paged radix-cache
+    replay must emit one valid JSON line whose hit ratio is REAL (> 0) —
+    the acceptance gate that warm turns actually resume from cached
+    pages, with warm-turn prefill reduced by the matched prefix."""
+    parsed, out = _run("bench.py", extra_env={"LFKT_BENCH_REPLAY": "1",
+                                              "LFKT_BENCH_CONVS": "2",
+                                              "LFKT_BENCH_TURNS": "3"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert parsed["value"] > 0                     # warm-turn TTFT p50
+    assert parsed["prefix_hit_ratio"] > 0, parsed
+    assert parsed["reused_tokens_total"] > 0
+    assert parsed["warm_turns"] >= 2
+    assert parsed["pool"]["pages_used"] > 0
+    # every turn past the very first must have found SOME cached prefix
+    warm = [t for t in parsed["per_turn"] if t["conv"] + t["turn"] > 0]
+    assert all(t["reused_tokens"] > 0 for t in warm), parsed["per_turn"]
+
+
 def test_bench_server_tiny_smoke():
     parsed, out = _run("bench_server.py",
                        extra_env={"LFKT_BENCH_N_REQ": "4",

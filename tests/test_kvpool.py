@@ -1,0 +1,427 @@
+"""Block-paged KV pool units (parallel/kvpool.py): radix-tree
+insert/match/split, refcount pinning vs eviction, LRU + spill/restore
+round trips, and pool-exhaustion backpressure — all against real tiny
+cache pytrees on CPU, with page contents checked BITWISE (the pool's
+whole contract is that a restored prefix is byte-identical to the ring
+it was committed from)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+from llama_fastapi_k8s_gpu_tpu.models.llama import init_cache
+from llama_fastapi_k8s_gpu_tpu.parallel.kvpool import _GROUP, KVPool
+
+CFG = ModelConfig(vocab_size=263, dim=16, n_layers=2, n_heads=4,
+                  n_kv_heads=2, ffn_dim=32, n_ctx=64)
+T = 8   # page size used throughout (8 tokens/page, 8 pages per full ring)
+
+
+def marked_ring(cfg=CFG, base: float = 100.0) -> dict:
+    """A ring whose every token slot is recognizable (value = base +
+    position), leaf-generic over bf16/int8 layouts — so a restored slice
+    can be compared bitwise against its source."""
+    ring = init_cache(cfg)
+
+    def mark(leaf, off):
+        pos = jnp.arange(cfg.n_ctx, dtype=jnp.float32)
+        pos = pos.reshape((1, 1, cfg.n_ctx) + (1,) * (leaf.ndim - 3))
+        if leaf.dtype == jnp.int8:
+            return jnp.broadcast_to(pos % 100, leaf.shape).astype(jnp.int8)
+        return jnp.broadcast_to(pos + base + off, leaf.shape).astype(
+            leaf.dtype)
+
+    return {k: mark(v, 10 * i) for i, (k, v) in enumerate(ring.items())}
+
+
+def assert_prefix_equal(got: dict, want: dict, tokens: int) -> None:
+    for key in want:
+        g = np.asarray(got[key][:, :, :tokens], np.float32)
+        w = np.asarray(want[key][:, :, :tokens], np.float32)
+        assert np.array_equal(g, w), key
+
+
+# ---------------------------------------------------------------------------
+# round trips
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_commit_acquire_restore_round_trip_bitwise(kv_dtype):
+    cfg = ModelConfig(**{**CFG.__dict__, "kv_dtype": kv_dtype})
+    pool = KVPool(cfg, page_tokens=T, n_pages=8)
+    ring = marked_ring(cfg)
+    ids = list(range(1, 25))                       # 3 full pages
+    assert pool.commit(ids, ring) == 3
+    assert pool.match_len(ids) == 24
+    lease = pool.acquire(ids, 16)
+    assert lease is not None and lease.tokens == 16
+    out = pool.restore(lease, init_cache(cfg))
+    assert_prefix_equal(out, ring, 16)
+    pool.release(lease)
+    assert pool.occupancy()["pages_pinned"] == 0
+
+
+def test_multi_group_dispatch_round_trip():
+    """More pages than one jitted dispatch moves (> _GROUP): the group
+    loop must tile the copy without gaps or reordering."""
+    cfg = ModelConfig(**{**CFG.__dict__, "n_ctx": 128})
+    pool = KVPool(cfg, page_tokens=T, n_pages=_GROUP * 2 + 4)
+    ring = marked_ring(cfg)
+    n_tok = (_GROUP + 3) * T                       # 11 pages > one group
+    ids = list(range(1, n_tok + 1))
+    assert pool.commit(ids, ring) == _GROUP + 3
+    lease = pool.acquire(ids + [999], n_tok)
+    assert lease is not None
+    out = pool.restore(lease, init_cache(cfg))
+    assert_prefix_equal(out, ring, n_tok)
+    pool.release(lease)
+
+
+def test_commit_dedupes_and_extends():
+    pool = KVPool(CFG, page_tokens=T, n_pages=8)
+    ring = marked_ring()
+    ids = list(range(1, 17))
+    assert pool.commit(ids, ring) == 2
+    assert pool.commit(ids, ring) == 0             # fully cached: no store
+    longer = ids + list(range(30, 38))
+    assert pool.commit(longer, ring) == 1          # only the new tail page
+    assert pool.match_len(longer) == 24
+
+
+# ---------------------------------------------------------------------------
+# radix structure
+# ---------------------------------------------------------------------------
+
+def test_match_is_page_granular():
+    pool = KVPool(CFG, page_tokens=T, n_pages=8)
+    ring = marked_ring()
+    ids = list(range(1, 17))
+    pool.commit(ids, ring)
+    # 1.5 pages of agreement only credits the full page
+    assert pool.match_len(ids[:12]) == T
+    # sub-page prompts can never match
+    assert pool.match_len(ids[:6]) == 0
+    # divergence inside page 2: only page 1 counts
+    assert pool.match_len(ids[:10] + [77, 78, 79, 80, 81, 82]) == T
+
+
+def test_radix_split_on_divergence():
+    """Two sequences sharing 2 pages and diverging in the 3rd must split
+    the stored edge at the page boundary: one shared upper node, two
+    sibling tails — and both remain fully matchable."""
+    pool = KVPool(CFG, page_tokens=T, n_pages=8)
+    ring = marked_ring()
+    a = list(range(1, 25))                         # pages P1 P2 P3
+    b = list(range(1, 17)) + list(range(50, 58))   # pages P1 P2 P3'
+    assert pool.commit(a, ring) == 3
+    assert pool.commit(b, ring) == 1               # only P3' is new
+    assert pool.match_len(a) == 24
+    assert pool.match_len(b) == 24
+    root_children = list(pool._root.children.values())
+    assert len(root_children) == 1                 # shared P1P2 upper node
+    upper = root_children[0]
+    assert len(upper.edge) == 2
+    assert len(upper.children) == 2                # the two diverging tails
+    # restored content stays correct through the split
+    lease = pool.acquire(b + [999], 24)
+    out = pool.restore(lease, init_cache(CFG))
+    assert_prefix_equal(out, ring, 16)             # shared prefix pages
+    pool.release(lease)
+
+
+# ---------------------------------------------------------------------------
+# refcounts, LRU eviction, spill tier
+# ---------------------------------------------------------------------------
+
+def test_pinned_pages_cannot_be_evicted():
+    pool = KVPool(CFG, page_tokens=T, n_pages=4)
+    ring = marked_ring()
+    a = list(range(1, 17))                         # 2 pages
+    pool.commit(a, ring)
+    lease = pool.acquire(a + [999], 16)
+    assert lease is not None
+    assert pool.occupancy()["pages_pinned"] == 2
+    # demand every page in the pool: the commit degrades to the 2 pages
+    # the pinned ones leave free — never touching the pinned pair
+    assert pool.commit([100 + i for i in range(32)], ring) == 2
+    # a further 2-page demand evicts the (unpinned) 100s node, not a
+    assert pool.commit([200 + i for i in range(16)], ring) == 2
+    assert pool.counters["evictions"] >= 1
+    out = pool.restore(lease, init_cache(CFG))
+    assert_prefix_equal(out, ring, 16)             # pinned pages intact
+    pool.release(lease)
+
+
+def test_lru_eviction_discards_without_spill():
+    pool = KVPool(CFG, page_tokens=T, n_pages=4, spill_pages=0)
+    ring = marked_ring()
+    a = list(range(1, 17))
+    b = list(range(100, 116))
+    pool.commit(a, ring)
+    pool.commit(b, ring)
+    # touch b so a is LRU, then demand 2 pages
+    assert pool.match_len(b) == 16
+    lease = pool.acquire(b + [999], 16)
+    pool.release(lease)
+    pool.commit([200 + i for i in range(16)], ring)
+    assert pool.counters["evictions"] >= 1
+    assert pool.counters["spills"] == 0
+    assert pool.match_len(a) == 0                  # discarded, not spilled
+    assert pool.match_len(b) == 16                 # MRU survived
+
+
+def test_spill_and_restore_round_trip_bitwise():
+    pool = KVPool(CFG, page_tokens=T, n_pages=4, spill_pages=8)
+    ring = marked_ring()
+    a = list(range(1, 17))
+    pool.commit(a, ring)
+    # force a's eviction: fill the pool twice over with younger content
+    pool.commit([100 + i for i in range(16)], ring)
+    pool.commit([200 + i for i in range(16)], ring)
+    assert pool.counters["spills"] >= 1
+    assert pool.match_len(a) == 16                 # spilled, still indexed
+    occ = pool.occupancy()
+    assert occ["spill_pages_used"] >= 2
+    lease = pool.acquire(a + [999], 16)            # hit restores to HBM
+    assert lease is not None
+    assert pool.counters["restores"] >= 1
+    out = pool.restore(lease, init_cache(CFG))
+    assert_prefix_equal(out, ring, 16)             # DMA'd round trip exact
+    pool.release(lease)
+    # a is device-resident again: a second acquire needs no further
+    # spill-restores (another node may have spilled to make room — the
+    # pool was full — so spill occupancy itself need not shrink)
+    before = pool.counters["restores"]
+    lease2 = pool.acquire(a + [999], 16)
+    assert lease2 is not None and pool.counters["restores"] == before
+    pool.release(lease2)
+
+
+def test_spill_tier_ages_lru_when_full():
+    pool = KVPool(CFG, page_tokens=T, n_pages=4, spill_pages=2)
+    ring = marked_ring()
+    seqs = [[100 * k + i for i in range(16)] for k in range(1, 5)]
+    for s in seqs:
+        pool.commit(s, ring)
+    # the spill tier (2 pages) can hold at most one 2-page node; older
+    # spilled nodes age out rather than growing host RAM unboundedly
+    assert pool.occupancy()["spill_pages_used"] <= 2
+
+
+def test_oversized_victim_does_not_drain_spill_tier():
+    """A victim larger than the whole spill tier can never fit it:
+    eviction must drop the victim directly instead of aging out every
+    warm spilled conversation for zero benefit."""
+    pool = KVPool(CFG, page_tokens=T, n_pages=4, spill_pages=1)
+    ring = marked_ring()
+    b = list(range(1, 9))                          # 1 page — spillable
+    a = list(range(100, 116))                      # 2 pages — oversized
+    pool.commit(b, ring)
+    pool.commit(a, ring)
+    pool.commit(list(range(200, 216)), ring)       # evicts b -> spilled
+    assert pool.occupancy()["spill_pages_used"] == 1
+    pool.commit(list(range(300, 332)), ring)       # evicts a (and the 200s)
+    # the oversized victims were dropped; the spilled b SURVIVED
+    assert pool.match_len(a) == 0
+    assert pool.match_len(b) == 8
+    assert pool.occupancy()["spill_pages_used"] == 1
+
+
+def test_aging_skipped_when_unageable_spill_blocks_fit():
+    """Spilled INTERIOR nodes cannot be aged away (dropping one would
+    orphan its subtree).  When they alone keep the tier too full for the
+    victim, aging must not sacrifice the warm spilled leaves first and
+    then fail anyway — the victim drops directly and the leaves live."""
+    pool = KVPool(CFG, page_tokens=T, n_pages=6, spill_pages=3)
+    ring = marked_ring()
+    a = list(range(1, 17))                         # 2 pages
+    ab = a + list(range(50, 58))                   # + 1-page child
+    lf = [200 + i for i in range(8)]               # 1-page leaf
+    assert pool.commit(a, ring) == 2
+    assert pool.commit(ab, ring) == 1
+    assert pool.commit(lf, ring) == 1              # used 4, free 2
+    with pool._lock:
+        upper = pool._root.children[tuple(a[:T])]
+        child = next(iter(upper.children.values()))
+        leafn = pool._root.children[tuple(lf[:T])]
+        upper.stamp, leafn.stamp, child.stamp = 1, 2, 3
+        pool._clock = 10
+        assert pool._evict_one()                   # spills a (interior, 2)
+        assert upper.pages is None and upper.host is not None
+        assert pool._evict_one()                   # spills lf (leaf, 1)
+        assert leafn.pages is None
+        assert pool._spill_used == 3               # tier full
+    v = [300 + i for i in range(16)]               # 2-page future victim
+    assert pool.commit(v, ring) == 2
+    with pool._lock:
+        vnode = pool._root.children[tuple(v[:T])]
+        vnode.stamp = 4                            # LRU among device nodes
+        child.stamp = 9                            # (child stays warmest)
+        assert pool._evict_one()                   # victim can't fit: 2 +
+        #                                            2 unageable > 3
+    assert pool.match_len(v) == 0                  # dropped, not spilled
+    assert pool.match_len(lf) == 8                 # warm leaf SURVIVED
+    assert pool.occupancy()["spill_pages_used"] == 3
+
+
+def test_exhaustion_is_backpressure_not_failure():
+    """Every page pinned: lookups miss, commits skip, nothing raises —
+    the engine-level contract that requests queue rather than OOM."""
+    pool = KVPool(CFG, page_tokens=T, n_pages=2)
+    ring = marked_ring()
+    a = list(range(1, 17))
+    pool.commit(a, ring)
+    lease = pool.acquire(a + [999], 16)            # pins the whole pool
+    assert pool.commit([300 + i for i in range(16)], ring) == 0
+    assert pool.acquire([300 + i for i in range(17)], 16) is None
+    assert pool.counters["misses"] >= 1
+    assert pool.counters["store_skips"] >= 1
+    pool.release(lease)
+    assert pool.commit([300 + i for i in range(16)], ring) == 2
+
+
+def test_reset_frees_everything():
+    pool = KVPool(CFG, page_tokens=T, n_pages=4, spill_pages=4)
+    ring = marked_ring()
+    pool.commit(list(range(1, 17)), ring)
+    pool.reset()
+    occ = pool.occupancy()
+    assert occ["pages_free"] == 4 and occ["pages_used"] == 0
+    assert occ["spill_pages_used"] == 0
+    assert pool.match_len(list(range(1, 17))) == 0
+
+
+def test_arena_bytes_and_page_geometry():
+    pool = KVPool(CFG, page_tokens=T, n_pages=4)
+    occ = pool.occupancy()
+    # bf16 k+v: 2 leaves * L * n_kv * T * hd * 2 bytes
+    hd = CFG.head_dim
+    expect_page = 2 * CFG.n_layers * CFG.n_kv_heads * T * hd * 2
+    assert occ["page_bytes"] == expect_page
+    assert occ["arena_bytes"] == 4 * expect_page
+    assert pool.arena_nbytes == occ["arena_bytes"]
+
+
+def test_page_tokens_validation():
+    with pytest.raises(ValueError):
+        KVPool(CFG, page_tokens=0)
+    with pytest.raises(ValueError):
+        KVPool(CFG, page_tokens=CFG.n_ctx)
+
+
+def test_metrics_sink_emission():
+    """Event counters flow into the host's metrics_sink when one is
+    installed (the server injects it; None must stay free)."""
+
+    class Sink:
+        def __init__(self):
+            self.incs = []
+            self.obs = []
+
+        def inc(self, name, value=1.0, **kw):
+            self.incs.append(name)
+
+        def observe(self, name, value, **kw):
+            self.obs.append((name, value))
+
+    class Host:
+        metrics_sink = None
+
+    host = Host()
+    pool = KVPool(CFG, page_tokens=T, n_pages=2, sink_host=host)
+    ring = marked_ring()
+    pool.commit(list(range(1, 17)), ring)
+    pool.note_miss()                               # sink None: no crash
+    host.metrics_sink = Sink()
+    pool.note_miss()
+    lease = pool.acquire(list(range(1, 18)), 16)
+    pool.release(lease)
+    pool.commit([300 + i for i in range(16)], ring)    # forces eviction
+    sink = host.metrics_sink
+    assert "prefix_cache_misses_total" in sink.incs
+    assert "prefix_cache_evictions_total" in sink.incs
+    assert ("prefix_reuse_tokens", 16) in sink.obs
+
+
+# ---------------------------------------------------------------------------
+# error paths: a failed device copy must never leak pages or pins
+# ---------------------------------------------------------------------------
+
+def _boom(*_a, **_k):
+    raise RuntimeError("injected page-copy failure")
+
+
+def _spill_child(pool, a, ab):
+    """Commit ``a`` then its extension ``ab`` and spill the child node,
+    returning (upper, child) — the acquire walk then pins device pages
+    before hitting the spilled node."""
+    ring = marked_ring()
+    assert pool.commit(a, ring) == 2
+    assert pool.commit(ab, ring) == 1
+    with pool._lock:
+        upper = pool._root.children[tuple(a[:T])]
+        child = next(iter(upper.children.values()))
+        child.stamp, upper.stamp = 1, 5
+        pool._clock = 10
+        assert pool._evict_one()                   # LRU: spills the child
+        assert child.pages is None and child.host is not None
+    return upper, child
+
+
+def test_store_failure_skips_commit_and_frees_pages(monkeypatch):
+    """A page-store dispatch failure degrades to a store skip: the
+    allocated-but-unindexed pages return to the free list (not leaked off
+    both the free list and the tree) and the pool keeps serving."""
+    from llama_fastapi_k8s_gpu_tpu.parallel import kvpool
+
+    pool = KVPool(CFG, page_tokens=T, n_pages=8)
+    ring = marked_ring()
+    free0 = pool.occupancy()["pages_free"]
+    monkeypatch.setattr(kvpool, "_store_pages_jit", _boom)
+    assert pool.commit(list(range(1, 17)), ring) == 0
+    assert pool.counters["store_skips"] == 1
+    assert pool.occupancy()["pages_free"] == free0
+    monkeypatch.undo()
+    assert pool.commit(list(range(1, 17)), ring) == 2   # pool still works
+
+
+def test_spill_restore_failure_degrades_to_miss_without_leaks(monkeypatch):
+    """An upload failure while restoring a spilled node converts the
+    acquire to a miss: pages pinned earlier in the walk are unreffed and
+    the restore-target slots go back on the free list — repeated failures
+    must not walk the pool into a pinned-solid state."""
+    from llama_fastapi_k8s_gpu_tpu.parallel import kvpool
+
+    pool = KVPool(CFG, page_tokens=T, n_pages=8, spill_pages=4)
+    a = list(range(1, 17))                         # 2 pages
+    ab = a + list(range(50, 58))                   # + 1-page child
+    _spill_child(pool, a, ab)
+    free0 = pool.occupancy()["pages_free"]
+    misses0 = pool.counters["misses"]
+    monkeypatch.setattr(kvpool, "_upload_pages_jit", _boom)
+    assert pool.acquire(ab, 24) is None
+    occ = pool.occupancy()
+    assert occ["pages_pinned"] == 0
+    assert occ["pages_free"] == free0
+    assert pool.counters["misses"] == misses0 + 1
+    monkeypatch.undo()
+    lease = pool.acquire(ab, 24)                   # pool still works
+    assert lease is not None and lease.tokens == 24
+    pool.release(lease)
+
+
+def test_acquire_walk_exception_unpins(monkeypatch):
+    """Any unexpected exception inside the pin walk degrades to a miss
+    with every already-pinned page unreffed (not a permanently
+    unevictable set)."""
+    pool = KVPool(CFG, page_tokens=T, n_pages=8, spill_pages=4)
+    a = list(range(1, 17))
+    ab = a + list(range(50, 58))
+    _spill_child(pool, a, ab)
+    monkeypatch.setattr(KVPool, "_restore_node", _boom)
+    assert pool.acquire(ab, 24) is None
+    assert pool.occupancy()["pages_pinned"] == 0

@@ -90,25 +90,37 @@ def render_trace(trace: dict) -> str:
             extra = f"  t={span['attrs']['tokens']}"
         lines.append(f"{name:<{NAME_COL}} {_fmt_ms(start)} "
                      f"{_fmt_ms(dur)} |{bar}|{open_marker}{extra}")
+        def duration_bar(at, host_s, glyph, label, suffix):
+            # a timed event rendered as a bar ENDING at its timestamp
+            # (producers stamp the event after the work), so back-to-back
+            # events visibly tile their parent span
+            mark = min(int(at / total * WIDTH), WIDTH - 1)
+            lo = max(0, min(int((at - host_s) / total * WIDTH), mark))
+            ebar = (" " * lo + glyph * max(mark - lo + 1, 1)
+                    + " " * (WIDTH - mark - 1))[:WIDTH]
+            ename = (" " * ((depth + 1) * INDENT) + "* " + label)[:NAME_COL]
+            lines.append(f"{ename:<{NAME_COL}} {_fmt_ms(at - host_s)} "
+                         f"{_fmt_ms(host_s)} |{ebar}|  {suffix}")
+
         for ev in span.get("events", ()):
             at = ev["at"] - t0
-            mark = min(int(at / total * WIDTH), WIDTH - 1)
             host_s = ev.get("host_s")
             if ev["name"] == "prefill_slice" and host_s is not None:
-                # overlapped-prefill slice: render its host wall as a ▒ bar
-                # ENDING at the event timestamp (slices stamp their event
-                # after dispatch), so back-to-back slices visibly tile the
-                # prefill span — the overlap picture the round-6 pipeline
-                # exists for.  Label carries offset/tokens.
-                lo = max(0, min(int((at - host_s) / total * WIDTH), mark))
-                sbar = (" " * lo + "▒" * max(mark - lo + 1, 1)
-                        + " " * (WIDTH - mark - 1))[:WIDTH]
-                ename = (" " * ((depth + 1) * INDENT)
-                         + f"* slice@{ev.get('offset', '?')}")[:NAME_COL]
-                lines.append(f"{ename:<{NAME_COL}} {_fmt_ms(at - host_s)} "
-                             f"{_fmt_ms(host_s)} |{sbar}|"
-                             f"  n={ev.get('tokens', '?')}")
+                # overlapped-prefill slice (▒): the overlap picture the
+                # round-6 pipeline exists for
+                duration_bar(at, host_s, "▒",
+                             f"slice@{ev.get('offset', '?')}",
+                             f"n={ev.get('tokens', '?')}")
                 continue
+            if ev["name"] in ("kv_restore", "kv_spill", "kv_spill_restore") \
+                    and host_s is not None:
+                # paged-KV page movement (░, parallel/kvpool.py): the
+                # copy/DMA cost in the same waterfall as the prefill
+                # slices it delays
+                duration_bar(at, host_s, "░", ev["name"],
+                             f"pages={ev.get('pages', '?')}")
+                continue
+            mark = min(int(at / total * WIDTH), WIDTH - 1)
             tick = " " * mark + "▲" + " " * (WIDTH - mark - 1)
             ename = (" " * ((depth + 1) * INDENT) + "* " + ev["name"])[:NAME_COL]
             lines.append(f"{ename:<{NAME_COL}} {_fmt_ms(at)} {'':>6} |{tick}|")
