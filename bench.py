@@ -47,6 +47,25 @@ import time
 
 A10G_Q4KM_8B_TOK_S = 45.0  # midpoint of the 30-60 tok/s llama.cpp A10G range
 
+
+def emit_result(d: dict) -> None:
+    """Print one bench JSON line, stamped with provenance: git commit,
+    device kind, and the LFKT_* knob fingerprint (utils/provenance.py).
+    tools/check_manifest.py validates the stamp schema over the banked
+    corpus, and tools/perf_gate.py refuses cross-knob-set comparisons.
+    The stamp import is guarded: the parent's guaranteed failure JSON
+    must print even from a checkout whose package does not import — the
+    exact deterministic-ImportError class that fails every child attempt.
+    Shared with bench_server.py (which delegates here; one copy only)."""
+    try:
+        from llama_fastapi_k8s_gpu_tpu.utils.provenance import stamp
+
+        d = {**d, "provenance": stamp()}
+    except Exception:
+        pass  # metadata must never eat the result line
+    print(json.dumps(d), flush=True)
+
+
 _INIT_MARK = "LFKT_INIT_OK"
 
 #: leaf key that marks a fused-layout weight dict per bench format — the
@@ -368,7 +387,7 @@ def coldstart_main() -> None:
         "load_phases": getattr(eng, "load_phases", None),
         "device": str(dev),
     }
-    print(json.dumps(result), flush=True)
+    emit_result(result)
 
 
 def write_coldstart_file(path: str) -> None:
@@ -599,7 +618,7 @@ def ttft_sweep_main() -> None:
                 "device": str(dev),
             }
             line.update(fallbacks)
-            print(json.dumps(line), flush=True)
+            emit_result(line)
 
 
 def replay_main() -> None:
@@ -712,7 +731,7 @@ def replay_main() -> None:
             for c, t, ttft, reused in calls],
         "device": str(dev),
     }
-    print(json.dumps(line), flush=True)
+    emit_result(line)
 
 
 def child_main() -> None:
@@ -954,7 +973,7 @@ def child_main() -> None:
         "compile_s": round(compile_s, 1),
     }
     result.update(fallbacks)
-    print(json.dumps(result), flush=True)
+    emit_result(result)
 
 
 # ---------------------------------------------------------------------------
@@ -1164,14 +1183,14 @@ def main() -> None:
         metric = f"ttft_ms_p50[ttft-sweep,{preset},{wfmt}]"
     else:
         metric = f"decode_tokens_per_sec_per_chip[{preset},{wfmt},synthetic]"
-    print(json.dumps({
+    emit_result({
         "metric": metric,
         "value": 0.0,
         "unit": "ms" if sweep or replay else "tokens/sec/chip",
         "vs_baseline": 0.0,
         "error": f"{len(errors)} attempt(s) failed; last: {errors[-1][:500]}",
         "attempts": len(errors),
-    }), flush=True)
+    })
     sys.exit(1)  # failure JSON is on stdout either way; CI must see rc!=0
 
 

@@ -31,6 +31,16 @@ import time
 import urllib.error
 import urllib.request
 
+
+def emit_result(d: dict) -> None:
+    """One provenance-stamped bench JSON line — single implementation in
+    bench.py (shared like probe_fused_or_degrade, so the benches can't
+    drift in what they stamp or how failure lines are guaranteed)."""
+    from bench import emit_result as _emit
+
+    _emit(d)
+
+
 A10G_TTFT_MS = 300.0  # BASELINE.md: p50 TTFT < 300 ms on /response
 
 
@@ -398,7 +408,7 @@ def main() -> None:
             "wall_s": round(mt_s, 1),
             "device": str(dev),
         }
-        print(json.dumps(result), flush=True)
+        emit_result(result)
         os._exit(0)  # daemon server thread: skip graceful asyncio teardown
 
     if multiturn:
@@ -490,7 +500,7 @@ def main() -> None:
             "per_turn": per_turn,
             "device": str(dev),
         }
-        print(json.dumps(result), flush=True)
+        emit_result(result)
         return
 
     lat = []
@@ -629,7 +639,7 @@ def main() -> None:
             result["spec"] = read_metrics_counters(
                 ("spec_verify_steps_total", "spec_drafted_tokens_total",
                  "spec_accepted_tokens_total", "spec_fallback_steps_total"))
-    print(json.dumps(result), flush=True)
+    emit_result(result)
     os._exit(0)  # daemon server thread: skip graceful asyncio teardown
 
 

@@ -34,6 +34,7 @@ from ..parallel.batched import (
     batched_prefill_jit,
     init_batched_state,
 )
+from ..obs.devtime import timed_jit
 from ..parallel.mesh import make_mesh, shard_params, state_shardings
 from ..sampling.sample import (
     PENALTY_WINDOW,
@@ -59,6 +60,11 @@ def _batched_first_sample(logits, windows, wposes, keys, st, top_k=40):
         return tok, window, wpos + 1, key
 
     return jax.vmap(single)(logits, windows, wposes, keys)
+
+
+_batched_first_sample = timed_jit("batched_first_sample",
+                                  _batched_first_sample,
+                                  site="engine.batched")
 
 
 class MeshEngine(Engine):
@@ -107,8 +113,11 @@ class MeshEngine(Engine):
         server's /response/stream uses Engine's streaming generation)."""
         t0 = time.time()
         msgs = [{"role": "user", "content": "hi"}]
+        # TWO full decode chunks: chunk 2's donated state carries jit-chosen
+        # shardings, a distinct compile the one-chunk warmup used to leave
+        # for the first real request (devtime pin, tests/test_perf_pins.py)
         self.create_chat_completions([msgs] * self.batch_size,
-                                     max_tokens=self.decode_chunk + 1,
+                                     max_tokens=2 * self.decode_chunk + 1,
                                      temperature=0.0)
         with self._lock:   # uncontended at warmup; keeps the _bstate
             #                write invariant (writes only under _lock)
@@ -328,6 +337,8 @@ class MeshEngine(Engine):
         timings = {
             "ttft_s": ttft, "decode_s": decode_s,
             "prompt_tokens": int(sum(len(i) for i in ids_list[:n_real])),
+            # shared cycle: every lane prefilled in one bucket program
+            "bucket": bucket,
             "completion_tokens": total_new,
             "tokens_per_sec": (total_new - n_real) / decode_s
             if decode_s > 0 and total_new > n_real else 0.0,

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..models.generate import prefill_chunk_jit, sample_jit
 from ..models.llama import init_cache
+from ..obs.devtime import timed_jit
 from ..parallel.batched import (
     batched_generate_chunk_perlane_jit,
     batched_spec_verify_perlane_jit,
@@ -70,6 +71,9 @@ def _write_lane(state: dict, lane_st: dict, lane: jax.Array, cache1: dict,
     return new_state, new_lane_st
 
 
+_write_lane = timed_jit("lane_write", _write_lane, site="engine.continuous")
+
+
 @jax.jit
 def _lane_cache_copy_jit(cache: dict, lane) -> dict:
     """Snapshot one lane's KV ring into a scratch-shaped cache (lane-prefix
@@ -77,6 +81,10 @@ def _lane_cache_copy_jit(cache: dict, lane) -> dict:
     suffix slices start from the reused history instead of position 0).
     Leaf-generic over the cache pytree (bf16 or int8 layout)."""
     return jax.tree.map(lambda a: a[lane], cache)
+
+
+_lane_cache_copy_jit = timed_jit("lane_cache_copy", _lane_cache_copy_jit,
+                                 site="engine.continuous")
 
 
 _STREAM_END = object()   # scheduler→stream-consumer sentinel
@@ -1007,6 +1015,8 @@ class ContinuousEngine(MeshEngine):
             "ttft_s": slot.ttft_s, "decode_s": decode_s,
             "prompt_tokens": slot.n_prompt, "completion_tokens": n,
             "prefix_reused_tokens": slot.reused,
+            # prompt bucket for the per-bucket TTFT series (obs/slo.py)
+            "bucket": self._bucket_for(slot.n_prompt),
             "tokens_per_sec": (n - 1) / decode_s
             if n > 1 and decode_s > 0 else 0.0,
         }

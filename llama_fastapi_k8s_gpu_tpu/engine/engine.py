@@ -499,12 +499,18 @@ class Engine:
         (compiles the plain chunk fallback)."""
         t0 = time.time()
         msgs = [{"role": "user", "content": "hi hi hi hi hi hi hi hi"}]
-        self.create_chat_completion(msgs, max_tokens=self.decode_chunk + 1,
+        # TWO full decode chunks, not one: on the sharded engines the
+        # donated state returns from chunk 1 with jit-chosen shardings, so
+        # the steady-state chunk-2 signature is a distinct compile — found
+        # by the devtime compile pins (tests/test_perf_pins.py), which now
+        # hold warmup to "compiles everything steady-state decode runs"
+        self.create_chat_completion(msgs,
+                                    max_tokens=2 * self.decode_chunk + 1,
                                     temperature=0.0)
         if self._spec_enabled():
             self.create_chat_completion(
                 [{"role": "user", "content": "alpha bravo charlie delta"}],
-                max_tokens=self.decode_chunk + 1, temperature=0.0)
+                max_tokens=2 * self.decode_chunk + 1, temperature=0.0)
         with self._lock:   # uncontended at warmup; the ring-write invariant
             #                (writes to _cache only under _lock) stays intact
             for b in self.prefill_buckets[1:]:
@@ -814,6 +820,7 @@ class Engine:
             "state": state, "st": st, "sp": sp, "n_prompt": n_prompt,
             "ids": [], "prompt_ids": ids, "first": first, "t0": t0,
             "reused": reuse, "ttft_s": ttft_s, "span": espan,
+            "bucket": bucket,
         }
 
     def _prefix_reuse_len(self, ids: list, n_prompt: int, bucket: int) -> int:
@@ -929,6 +936,8 @@ class Engine:
             "prompt_tokens": ctx["n_prompt"],
             "completion_tokens": n,
             "prefix_reused_tokens": ctx.get("reused", 0),
+            # prompt bucket for the per-bucket TTFT series (obs/slo.py)
+            "bucket": ctx.get("bucket", 0),
             # first token came out of prefill; the decode phase produced n-1
             "tokens_per_sec": (n - 1) / decode_s if n > 1 and decode_s > 0 else 0.0,
         }

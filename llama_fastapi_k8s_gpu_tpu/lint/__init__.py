@@ -27,6 +27,10 @@ This package checks them at test time, on CPU, stdlib-``ast`` only:
                       (the docs table is generated from it).
 - :mod:`.kernels`   — KER001-003: Pallas kernels carry an interpret gate,
                       a probe or XLA fallback, and static block shapes.
+- :mod:`.perf`      — PERF001-002: every jit/pallas entry point is
+                      registered with the devtime compile/dispatch
+                      registry (obs/devtime.py), and every SLO references
+                      a cataloged metric family (obs/slo.py).
 - :mod:`.deadcode`  — DEAD001-002: unreferenced module-level functions and
                       bogus ``__all__`` entries.
 

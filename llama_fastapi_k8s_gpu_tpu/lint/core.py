@@ -156,10 +156,11 @@ def _py_files(root: str) -> list[str]:
 
 def _checkers() -> list[tuple[dict, Callable[[Context], list[Finding]]]]:
     # imported lazily so a syntax error in one checker names itself cleanly
-    from . import configreg, deadcode, jit, kernels, locks, obsreg
+    from . import configreg, deadcode, jit, kernels, locks, obsreg, perf
 
     return [(mod.RULES, mod.check)
-            for mod in (locks, jit, configreg, obsreg, kernels, deadcode)]
+            for mod in (locks, jit, configreg, obsreg, kernels, perf,
+                        deadcode)]
 
 
 def all_rules() -> dict[str, str]:

@@ -17,6 +17,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..obs.devtime import timed_jit
 from ..sampling.sample import PENALTY_WINDOW, sample_chain
 from .config import ModelConfig
 from .llama import forward, init_cache, prefill
@@ -41,6 +42,9 @@ def prefill_jit(params, cfg: ModelConfig, tokens, length, cache):
     return prefill(params, cfg, tokens, length, cache)
 
 
+prefill_jit = timed_jit("prefill", prefill_jit, site="models.generate")
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def prefill_chunk_jit(params, cfg: ModelConfig, tokens, pos_offset, last_idx,
                       cache):
@@ -53,6 +57,10 @@ def prefill_chunk_jit(params, cfg: ModelConfig, tokens, pos_offset, last_idx,
     return forward(params, cfg, tokens, pos_offset, cache, last_idx=last_idx)
 
 
+prefill_chunk_jit = timed_jit("prefill_chunk", prefill_chunk_jit,
+                              site="models.generate")
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "top_k"))
 def sample_jit(logits, window, wpos, key, st, cfg: ModelConfig, top_k: int = 40):
     """Sample the first token (from prefill logits) and update sampler state."""
@@ -60,6 +68,9 @@ def sample_jit(logits, window, wpos, key, st, cfg: ModelConfig, top_k: int = 40)
     token = sample_chain(logits, window, sub, st, top_k=top_k)
     window = window.at[wpos % PENALTY_WINDOW].set(token)
     return token, window, wpos + 1, key
+
+
+sample_jit = timed_jit("first_sample", sample_jit, site="models.generate")
 
 
 def generate_chunk(params, cfg: ModelConfig, state: dict, st: dict,
@@ -101,6 +112,10 @@ def generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
     Returns (new_state, tokens (n_steps,)) — the tokens sampled this chunk.
     """
     return generate_chunk(params, cfg, state, st, n_steps, top_k)
+
+
+generate_chunk_jit = timed_jit("decode_chunk", generate_chunk_jit,
+                               site="models.generate")
 
 
 def spec_verify(params, cfg: ModelConfig, state: dict, st: dict,
@@ -177,8 +192,8 @@ def spec_verify(params, cfg: ModelConfig, state: dict, st: dict,
     return new_state, toks, fin["count"]
 
 
-spec_verify_jit = functools.partial(
+spec_verify_jit = timed_jit("spec_verify", functools.partial(
     jax.jit,
     static_argnames=("cfg", "top_k"),
     donate_argnames=("state",),
-)(spec_verify)
+)(spec_verify), site="models.generate")

@@ -35,6 +35,10 @@ OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
 #: token-count buckets (prefix reuse lengths: one page up to a 32k prompt)
 TOKEN_BUCKETS = (64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0,
                  8192.0, 16384.0, 32768.0)
+#: compile-wall buckets (seconds): CPU-tiny test programs compile in tens
+#: of ms, real 8B prefill programs in tens of seconds on a cold cache
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,8 +85,10 @@ METRICS: dict[str, Metric] = _register(
            "408s (admission timeout / stream deadline)"),
     # -- engine phase timings (SURVEY §5 per-phase timers) -----------------
     Metric("engine_ttft_seconds", HISTOGRAM,
-           "time to first token (prefill + first sample)",
-           buckets=LATENCY_BUCKETS),
+           "time to first token (prefill + first sample), by prefill "
+           "bucket — the SLO engine evaluates each bucket series "
+           "separately (docs/SLO.md)",
+           buckets=LATENCY_BUCKETS, labels=("bucket",)),
     Metric("engine_decode_tokens_per_sec", HISTOGRAM,
            "per-request decode throughput",
            buckets=RATE_BUCKETS),
@@ -154,6 +160,32 @@ METRICS: dict[str, Metric] = _register(
     Metric("traces_started_total", GAUGE, "requests that drew a trace"),
     Metric("traces_sampled_out_total", GAUGE,
            "requests skipped by LFKT_TRACE_SAMPLE"),
+    # -- lfkt-perf: compile/dispatch attribution (obs/devtime.py) ----------
+    # per-program counters exported as point-in-time snapshots — the
+    # devtime registry owns the count; /metrics copies it (same convention
+    # as the tracer counters above)
+    Metric("xla_compiles_total", GAUGE,
+           "jit compile events per program (devtime snapshot)",
+           labels=("program",)),
+    Metric("jit_dispatches_total", GAUGE,
+           "host dispatches per jit program (devtime snapshot)",
+           labels=("program",)),
+    Metric("xla_recompile_storms_total", GAUGE,
+           "signatures minted past LFKT_RECOMPILE_BUDGET "
+           "(devtime snapshot; docs/RUNBOOK.md recompile-storm runbook)"),
+    Metric("xla_compile_seconds", HISTOGRAM,
+           "wall time of jit compile events, by program (first-dispatch "
+           "wall; replayed from the devtime event ring at scrape time)",
+           buckets=COMPILE_BUCKETS, labels=("program",)),
+    Metric("xla_compile_events_dropped_total", GAUGE,
+           "compile events evicted from the ring before replay — nonzero "
+           "means xla_compile_seconds undercounts vs xla_compiles_total "
+           "(a storm outran the scrape cadence)"),
+    # -- SLO engine (obs/slo.py; docs/SLO.md) ------------------------------
+    Metric("slo_burn_rate", GAUGE,
+           "error-budget burn rate per SLO and window (1.0 = burning "
+           "exactly the budget; sustained >1 on every window = breach)",
+           labels=("slo", "window")),
     # -- runtime-synthesized families --------------------------------------
     Metric("scheduler_", GAUGE,
            "continuous-scheduler occupancy family "

@@ -1,0 +1,405 @@
+"""Compile & dispatch attribution — THE jit program registry (lfkt-perf).
+
+The serving stack's hot path is a handful of jitted programs (prefill /
+decode-chunk programs, the continuous scheduler's lane ops, the KV pool's
+page-copy programs) plus trace-inner dispatch sites (fused quantized
+matmuls, flash attention, KV write-quantize) that compile *as part of*
+whichever host program traces them.  Before this module nothing could
+answer "what did this pod compile, when, and how often is it
+recompiling" — the exact failure mode (silent recompile storms, extra
+per-chunk dispatches) that erases kernel-level wins without failing a
+single test.
+
+Two registration forms, one registry:
+
+- :func:`timed_jit` wraps a HOST jit entry point.  Every call increments
+  the program's dispatch count; a call that grew the underlying jit cache
+  (``fn._cache_size()``, with a signature-set fallback on jax versions
+  without it) is a compile event: the program records the static-shape
+  signature and the call's wall time (first-dispatch wall ≈ compile wall,
+  the standard attribution), and the event is exported to the
+  ``xla_compiles_total`` / ``xla_compile_seconds`` /
+  ``jit_dispatches_total`` catalog families by the server's /metrics
+  render.
+- :func:`register_program` declares a TRACE-INNER dispatch site (a
+  ``jax.jit``/``pallas_call`` that only ever runs inside another traced
+  program — fused matmul builders, flash attention, write-quantize).
+  Inner programs compile as part of their enclosing entry's compile wall;
+  registration makes them inventory-visible at ``/debug/compiles`` and
+  satisfies lfkt-lint PERF001 (every jit/pallas entry point must be
+  registered — lint/perf.py).
+
+Recompile storms: a program whose distinct-signature set grows past
+``LFKT_RECOMPILE_BUDGET`` is flagged on the spot — a counter, a
+structured-log warning, and a ``recompile_storm`` event annotated onto
+every in-flight trace (obs/trace.py fan-in), so the requests a storm
+stalled carry the explanation in their own span trees.
+
+Zero cost when disarmed (``LFKT_DEVTIME=0``): the wrapper's first check
+is a plain attribute read and the call forwards untouched — no signature,
+no lock, no allocation (pinned by the poisoned-registry test in
+tests/test_devtime.py, the tracer's ``LFKT_TRACE_SAMPLE=0`` analogue).
+
+Determinism dividend: because compile/dispatch counts are exact and
+device-independent, tier-1 pins them on CPU (tests/test_perf_pins.py) —
+a silent recompile or a stray extra dispatch per decode chunk fails a
+CPU test long before it burns a chip session.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+
+logger = logging.getLogger(__name__)
+
+#: bounded compile-event ring: /metrics replays events it has not seen yet
+#: into the xla_compile_seconds histogram via a per-consumer cursor
+MAX_EVENTS = 1024
+#: full signature STRINGS retained per program (newest first out) — the
+#: /debug/compiles display and the per-signature compile walls.  Distinct
+#: counts and storm detection stay exact past this via a per-program set
+#: of signature hashes (8 bytes each): a sustained storm costs the ledger
+#: ~a word per mint, not a multi-KB string — negligible next to the
+#: compiled executable jax itself retains for every one of them.
+MAX_SIGNATURES_SHOWN = 64
+
+ENTRY = "entry"    # host-dispatched jit program (wrapped by timed_jit)
+INNER = "inner"    # trace-inner dispatch site (compiles inside its caller)
+
+
+def _describe_leaf(leaf) -> str:
+    """One signature atom: ``dtype[shape]`` for arrays, ``repr`` for plain
+    scalars/strings, ``TypeName#hash`` for hashable statics (ModelConfig),
+    ``TypeName`` otherwise.  Metadata only — never forces a device sync."""
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        return f"{leaf.dtype}[{','.join(str(d) for d in leaf.shape)}]"
+    if isinstance(leaf, (bool, int, float, str)) or leaf is None:
+        return repr(leaf)
+    try:
+        h = hash(leaf)
+    except TypeError:
+        return type(leaf).__name__
+    return f"{type(leaf).__name__}#{h & 0xFFFFFFFF:08x}"
+
+
+#: Fastest plausible jit compile wall.  The no-cache-probe fallback only
+#: computes a dispatch signature when the call's wall reaches this floor:
+#: trace+lower+LLVM is milliseconds even for `lambda x: x`, while a
+#: steady-state cache-hit dispatch stays well under it.
+_FALLBACK_COMPILE_FLOOR_S = 1e-3
+
+
+def _signature(args: tuple, kwargs: dict) -> str:
+    """Static-shape signature of one dispatch — the (shapes, dtypes,
+    statics) key a jit cache distinguishes programs by, rendered as a
+    stable string.  Computed only on compile-scale calls: with a cache
+    probe that means actual compile events (rare); without one, only
+    calls whose wall clears _FALLBACK_COMPILE_FLOOR_S — so the lazy jax
+    import and the O(leaves) tree walk never ride a steady-state
+    (sub-millisecond) dispatch."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    return ";".join(_describe_leaf(leaf) for leaf in leaves)
+
+
+class _Program:
+    """One registered program's ledger."""
+
+    __slots__ = ("name", "kind", "site", "signatures", "sig_seen",
+                 "compiles", "dispatches", "compile_s", "storms")
+
+    def __init__(self, name: str, kind: str, site: str | None):
+        self.name = name
+        self.kind = kind
+        self.site = site
+        #: signature -> {"wall_s": first-compile wall, "count": compiles};
+        #: bounded to MAX_SIGNATURES_SHOWN full strings (oldest evicted)
+        self.signatures: OrderedDict[str, dict] = OrderedDict()
+        #: hashes of every distinct signature ever seen — exact
+        #: distinct/storm accounting without retaining the strings
+        self.sig_seen: set[int] = set()
+        self.compiles = 0
+        self.dispatches = 0
+        self.compile_s = 0.0
+        self.storms = 0
+
+
+class DevtimeRegistry:
+    """The process-wide compile/dispatch ledger (module instance:
+    :data:`DEVTIME`).  Producers are engine worker threads, the continuous
+    scheduler thread, and load-time code; consumers are /metrics,
+    /debug/compiles, /debug/slo and the tier-1 perf pins."""
+
+    # every mutable table goes through one mutex (lfkt-lint LOCK001);
+    # _armed is the single hot-path bool, read without the lock by design
+    _GUARDED_BY = {"_programs": "_lock", "_events": "_lock",
+                   "_seq": "_lock", "storms_total": "_lock",
+                   "events_dropped": "_lock", "_floor": "_lock"}
+    _SHARED_ATOMIC = ("_armed", "budget")
+
+    def __init__(self, armed: bool | None = None, budget: int | None = None):
+        if armed is None or budget is None:
+            from ..utils.config import knob
+
+            if armed is None:
+                armed = bool(knob("LFKT_DEVTIME"))
+            if budget is None:
+                budget = int(knob("LFKT_RECOMPILE_BUDGET"))
+        self._lock = threading.Lock()
+        self._programs: dict[str, _Program] = {}
+        self._events: deque[dict] = deque(maxlen=MAX_EVENTS)
+        self._seq = 0                  # monotonic event id (survives reset)
+        self.storms_total = 0
+        #: events a consumer found already evicted from the ring (cursor
+        #: gap) — nonzero means xla_compile_seconds undercounts vs the
+        #: exact xla_compiles_total ledger: a storm minted >MAX_EVENTS
+        #: compiles inside one scrape interval and the tail was lost
+        self.events_dropped = 0
+        self._floor = 0        # events at or below this were reset, not dropped
+        self.budget = max(1, int(budget))
+        self._armed = bool(armed)
+
+    # -- configuration (tests + ops) ---------------------------------------
+    def configure(self, armed: bool | None = None,
+                  budget: int | None = None) -> None:
+        if armed is not None:
+            self._armed = bool(armed)
+        if budget is not None:
+            self.budget = max(1, int(budget))
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def reset(self) -> None:
+        """Zero every ledger (tests).  The event sequence stays monotonic
+        so /metrics cursors held by live apps never replay old events."""
+        with self._lock:
+            for p in self._programs.values():
+                p.signatures.clear()
+                p.sig_seen.clear()
+                p.compiles = p.dispatches = p.storms = 0
+                p.compile_s = 0.0
+            self._events.clear()
+            self.storms_total = 0
+            self.events_dropped = 0
+            self._floor = self._seq    # cleared events are not "dropped"
+
+    # -- registration ------------------------------------------------------
+    def _program(self, name: str, kind: str,
+                 site: str | None) -> _Program:  # lfkt: holds[_lock]
+        p = self._programs.get(name)
+        if p is None:
+            p = self._programs[name] = _Program(name, kind, site)
+        elif site is not None and p.site is None:
+            p.site = site
+        return p
+
+    def register_program(self, name: str, kind: str = INNER,
+                         site: str | None = None) -> str:
+        """Declare a program without wrapping it (trace-inner dispatch
+        sites).  Idempotent; returns the name so call sites can use it as
+        an expression."""
+        with self._lock:
+            self._program(name, kind, site)
+        return name
+
+    def timed_jit(self, name: str, fn, site: str | None = None):
+        """Wrap a host jit entry point.  Re-wrapping under the same name
+        (lru-cached factories minting one jit per mesh/config key) merges
+        into one program ledger — exactly what storm detection wants."""
+        with self._lock:
+            self._program(name, ENTRY, site)
+        return _TimedJit(self, name, fn)
+
+    # -- producer API ------------------------------------------------------
+    def record_dispatch(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._program(name, ENTRY, None).dispatches += n
+
+    def record_compile(self, name: str, signature: str, wall_s: float,
+                       new_only: bool = False) -> None:
+        """Record one compile event.  ``new_only`` is the fallback path for
+        jit callables without a cache-size probe: only an unseen signature
+        counts as a compile.  Storm side effects (log + trace fan-in) fire
+        outside the lock."""
+        storm = None
+        with self._lock:
+            p = self._program(name, ENTRY, None)
+            sig_h = hash(signature)
+            known = sig_h in p.sig_seen
+            if new_only and known:
+                return
+            if known:
+                entry = p.signatures.get(signature)
+                if entry is not None:     # display entry may be evicted
+                    entry["count"] += 1
+            else:
+                p.sig_seen.add(sig_h)
+                p.signatures[signature] = {"wall_s": round(wall_s, 6),
+                                           "count": 1}
+                while len(p.signatures) > MAX_SIGNATURES_SHOWN:
+                    p.signatures.popitem(last=False)
+            p.compiles += 1
+            p.compile_s += wall_s
+            self._seq += 1
+            self._events.append({"seq": self._seq, "program": name,
+                                 "wall_s": wall_s, "signature": signature,
+                                 "at": time.time()})
+            if not known and len(p.sig_seen) > self.budget:
+                p.storms += 1
+                self.storms_total += 1
+                storm = {"program": name, "signatures": len(p.sig_seen),
+                         "budget": self.budget}
+        if storm is not None:
+            logger.warning(
+                "recompile storm: program %s minted signature #%d "
+                "(budget %d) — static shapes are churning "
+                "(docs/RUNBOOK.md 'Diagnosing a recompile storm')",
+                storm["program"], storm["signatures"], storm["budget"],
+                extra=storm)
+            from .trace import annotate_all_inflight
+
+            annotate_all_inflight("recompile_storm", **storm)
+
+    # -- consumers ---------------------------------------------------------
+    def counters(self) -> dict[str, dict]:
+        """{program: {"compiles", "dispatches", "signatures", "storms"}} —
+        the cheap ledger for /metrics gauges and the tier-1 perf pins."""
+        with self._lock:
+            return {name: {"compiles": p.compiles,
+                           "dispatches": p.dispatches,
+                           "signatures": len(p.sig_seen),
+                           "storms": p.storms}
+                    for name, p in self._programs.items()}
+
+    def events_since(self, cursor: int) -> tuple[int, list[dict]]:
+        """Compile events newer than ``cursor`` (bounded ring) + the new
+        cursor — /metrics replays them into the xla_compile_seconds
+        histogram exactly once per consumer.  A cursor gap (the oldest
+        retained event is not the consumer's next) means the ring
+        overflowed between replays — a storm minting >MAX_EVENTS compiles
+        inside one scrape interval — and is surfaced rather than silently
+        skipped: ``events_dropped`` grows by the gap and a warning names
+        the undercounting series.  A negative cursor marks a NEVER-read
+        consumer (a freshly built app in a process whose ring already
+        overflowed): it replays the retained events and charges no gap —
+        those events were not lost between ITS scrapes."""
+        fresh = cursor < 0
+        lost = 0
+        with self._lock:
+            if cursor > self._seq:          # stale cursor across a reset
+                cursor = 0
+            if self._events and not fresh:
+                oldest = self._events[0]["seq"]
+                lost = max(0, (oldest - 1) - max(cursor, self._floor))
+                if lost:
+                    self.events_dropped += lost
+            events = [dict(e) for e in self._events if e["seq"] > cursor]
+            new_cursor = self._seq
+        if lost:
+            logger.warning(
+                "compile-event ring overflowed: %d event(s) evicted before "
+                "replay — xla_compile_seconds undercounts this interval "
+                "(xla_compiles_total stays exact)", lost,
+                extra={"events_dropped": lost})
+        return new_cursor, events
+
+    def storms(self) -> list[dict]:
+        """Programs currently past the signature budget (the /debug/slo
+        recompile verdict input)."""
+        with self._lock:
+            return [{"program": p.name, "signatures": len(p.sig_seen),
+                     "budget": self.budget, "storms": p.storms}
+                    for p in self._programs.values()
+                    if len(p.sig_seen) > self.budget]
+
+    def snapshot(self) -> dict:
+        """The full /debug/compiles document: program inventory with
+        per-signature compile walls (display-bounded)."""
+        with self._lock:
+            programs = []
+            for name in sorted(self._programs):
+                p = self._programs[name]
+                sigs = [{"signature": s, **meta}
+                        for s, meta in p.signatures.items()]
+                programs.append({
+                    "name": p.name, "kind": p.kind, "site": p.site,
+                    "compiles": p.compiles, "dispatches": p.dispatches,
+                    "compile_seconds_total": round(p.compile_s, 6),
+                    "signatures": len(p.sig_seen),
+                    "storms": p.storms,
+                    "signature_list": sigs,   # ledger bounds retention
+                })
+            return {"armed": self._armed, "budget": self.budget,
+                    "storms_total": self.storms_total,
+                    "events_dropped": self.events_dropped,
+                    "programs": programs}
+
+
+class _TimedJit:
+    """The per-entry-point wrapper ``timed_jit`` returns.  Call-compatible
+    with the wrapped jit function; donation, static args and sharding all
+    pass through untouched (the wrapper never copies or inspects buffers
+    beyond shape/dtype metadata, and only on compile events)."""
+
+    __slots__ = ("_reg", "_name", "_fn", "_probe", "__wrapped__")
+
+    def __init__(self, reg: DevtimeRegistry, name: str, fn):
+        self._reg = reg
+        self._name = name
+        self._fn = fn
+        self.__wrapped__ = fn
+        # jax's PjitFunction exposes its compiled-variant count; older
+        # versions fall back to registry signature-set membership
+        self._probe = getattr(fn, "_cache_size", None)
+
+    def __call__(self, *args, **kwargs):
+        reg = self._reg
+        if not reg._armed:          # disarmed: forward untouched, allocate
+            return self._fn(*args, **kwargs)   # nothing (poisoned-reg test)
+        probe = self._probe
+        before = probe() if probe is not None else -1
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        if probe is not None:
+            if probe() > before:
+                reg.record_compile(self._name, _signature(args, kwargs), dt)
+        elif dt >= _FALLBACK_COMPILE_FLOOR_S:
+            # No cache probe (old jax): signature-set membership detects
+            # compiles, but walking a ~300-leaf params tree per decode
+            # chunk is exactly the overhead this tool attributes.  A jit
+            # compile is never sub-millisecond, so a call that returns
+            # under the floor cannot have compiled and skips the walk;
+            # the first dispatch of any new signature pays compile wall
+            # and always clears it.  Membership lives in the REGISTRY
+            # ledger (new_only), not wrapper-private state, so reset()
+            # zeroes it with everything else; the lock it costs is one
+            # record_dispatch already pays on every call.
+            reg.record_compile(self._name, _signature(args, kwargs), dt,
+                               new_only=True)
+        reg.record_dispatch(self._name)
+        return out
+
+
+#: THE process-wide registry: entry points wrap themselves through it at
+#: import, /metrics + /debug/compiles read it, tier-1 pins its counters.
+DEVTIME = DevtimeRegistry()
+
+
+def timed_jit(name: str, fn, site: str | None = None):
+    """Module-level convenience: wrap ``fn`` as program ``name`` on the
+    process registry (the form every entry-point module uses)."""
+    return DEVTIME.timed_jit(name, fn, site=site)
+
+
+def register_program(name: str, kind: str = INNER,
+                     site: str | None = None) -> str:
+    """Module-level convenience: declare a trace-inner dispatch site on
+    the process registry (lfkt-lint PERF001's registration form)."""
+    return DEVTIME.register_program(name, kind=kind, site=site)

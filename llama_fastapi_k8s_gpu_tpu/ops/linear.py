@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.devtime import timed_jit
+
 
 def make_linear_bf16(w: np.ndarray) -> dict:
     """w: (out, in) float."""
@@ -45,6 +47,11 @@ def make_linear_int8_device(w: jax.Array) -> dict:
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(jnp.round(w / scale[:, None]), -127, 127).astype(jnp.int8)
     return {"q": q, "s": scale}
+
+
+make_linear_int8_device = timed_jit("load_linear_int8",
+                                    make_linear_int8_device,
+                                    site="ops.linear")
 
 
 def make_linear_q4k(w: np.ndarray) -> dict:

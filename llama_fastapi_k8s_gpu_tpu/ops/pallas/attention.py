@@ -48,6 +48,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...obs.devtime import register_program
+
 # Large-but-finite mask value: keeps exp() well-defined when an entire block
 # (or an entire padded row) is masked, unlike -inf.
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
@@ -317,3 +319,10 @@ def flash_attention(
 
     # (n_kv, group, S, hd) → (S, n_heads, hd)
     return out.reshape(n_kv, group, S, hd).transpose(2, 0, 1, 3).reshape(S, n_heads, hd)
+
+
+# devtime inventory (lfkt-lint PERF001): flash attention is a TRACE-INNER
+# dispatch site — it runs inside the prefill/decode entry programs, so its
+# compile wall is attributed to whichever host program traced it
+# (obs/devtime.py; /debug/compiles shows it under kind="inner")
+register_program("flash_attention", site="ops.pallas.attention")

@@ -34,6 +34,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
+from ...obs.devtime import register_program
 from ...gguf.quants import dequantize as np_dequantize, unpack_scale_min_k4
 
 # rows per grid step (row = one 128-lane vector of packed bytes)
@@ -353,3 +354,13 @@ def device_dequant(buf: np.ndarray, ggml_type: GGMLType, n: int,
             "device dequant kernel failed for %s; loading via the numpy "
             "codec from here on: %s", GGMLType(ggml_type).name, e)
         return _host_fallback(buf, ggml_type, n, dtype)
+
+
+# devtime inventory (lfkt-lint PERF001): the weight-load dequant kernels
+# are host-called once per layer during load; their walls ride the load
+# phases already reported by coldstart artifacts, so they are registered
+# as inventory rather than wrapped (obs/devtime.py)
+register_program("dequant_q8_0_device", site="ops.pallas.dequant")
+register_program("dequant_q4_k_device", site="ops.pallas.dequant")
+register_program("dequant_q5_k_device", site="ops.pallas.dequant")
+register_program("dequant_q6_k_device", site="ops.pallas.dequant")

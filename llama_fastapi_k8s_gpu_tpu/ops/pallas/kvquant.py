@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ...obs.devtime import register_program
+
 _FORCE_XLA: bool = False
 
 
@@ -108,3 +110,9 @@ def dequantize_kv(q: jax.Array, s: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
     its collectives) and by tests; the XLA/Pallas attention consumers fold
     the scales into their score/value matmuls instead and never call this."""
     return (q.astype(jnp.float32) * s[..., None].astype(jnp.float32)).astype(dtype)
+
+
+# devtime inventory (lfkt-lint PERF001): the KV write-quantize kernel is
+# trace-inner — it compiles as part of the prefill/decode programs that
+# call it from the cache-write path (obs/devtime.py)
+register_program("quantize_kv_pallas", site="ops.pallas.kvquant")

@@ -33,6 +33,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
+from ...obs.devtime import register_program
 from ...gguf.quants import _garbage_tolerant
 from ...gguf.quants import unpack_scale_min_k4
 from .qmatmul import (
@@ -539,3 +540,9 @@ def q5k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
             _interpret(interpret), "cur" if var == "pre" else var)
         y = batched_rows(fn, xpa, w["q5s"], w["q5h"], w["sm5"])
     return y.reshape(*lead, -1).astype(x.dtype)
+
+
+# devtime inventory (lfkt-lint PERF001): trace-inner fused-matmul builders
+# (see ops/pallas/qmatmul.py for the attribution contract)
+register_program("_q5k_2d_partitioned", site="ops.pallas.q5matmul")
+register_program("_q5k_pre_2d_partitioned", site="ops.pallas.q5matmul")

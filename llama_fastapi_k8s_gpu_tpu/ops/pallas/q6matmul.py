@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
+from ...obs.devtime import register_program
 from ...gguf.quants import _garbage_tolerant
 from .qmatmul import (
     batched_rows,
@@ -604,3 +605,9 @@ def q6k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
             _interpret(interpret), "cur" if var == "pre" else var)
         y = batched_rows(fn, xpa, w["q4"], w["q2"], w["sm6"])
     return y.reshape(*lead, -1).astype(x.dtype)
+
+
+# devtime inventory (lfkt-lint PERF001): trace-inner fused-matmul builders
+# (see ops/pallas/qmatmul.py for the attribution contract)
+register_program("_q6k_2d_partitioned", site="ops.pallas.q6matmul")
+register_program("_q6k_pre_2d_partitioned", site="ops.pallas.q6matmul")

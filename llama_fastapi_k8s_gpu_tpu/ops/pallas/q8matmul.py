@@ -35,6 +35,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType
+from ...obs.devtime import register_program
 from ...gguf.quants import _garbage_tolerant
 from .qmatmul import (
     batched_rows,
@@ -227,3 +228,8 @@ def q8_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Array
     fn = _q8_2d_partitioned(_interpret(interpret))
     y = batched_rows(fn, xp, w["q8"], w["sm8"])
     return y.reshape(*lead, -1).astype(x.dtype)
+
+
+# devtime inventory (lfkt-lint PERF001): trace-inner fused-matmul builder
+# (see ops/pallas/qmatmul.py for the attribution contract)
+register_program("_q8_2d_partitioned", site="ops.pallas.q8matmul")

@@ -71,6 +71,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ...gguf.constants import GGML_BLOCK_SIZES, GGMLType, QK_K
+from ...obs.devtime import register_program
 from ...gguf.quants import _garbage_tolerant, unpack_scale_min_k4
 
 TK = 2048            # K elements per kernel step = 8 super-blocks
@@ -704,3 +705,13 @@ def q4k_matmul(x: jax.Array, w: dict, interpret: bool | None = None) -> jax.Arra
         _interpret(interpret), _env_variant("LFKT_Q4K_KERNEL", Q4K_VARIANTS))
     y = batched_rows(fn, xpa, w["qs"], w["sm"])
     return y.reshape(*lead, -1).astype(x.dtype)
+
+
+# devtime inventory (lfkt-lint PERF001): the fused-matmul builders mint
+# trace-inner programs — every jit/pallas_call they create runs inside the
+# engines' prefill/decode entry programs, so compile walls are attributed
+# to those entries (obs/devtime.py; /debug/compiles kind="inner")
+register_program("plain_pallas_call", site="ops.pallas.qmatmul")
+register_program("stacked_pallas_call", site="ops.pallas.qmatmul")
+register_program("stacked_partitioned", site="ops.pallas.qmatmul")
+register_program("_q4k_2d_partitioned", site="ops.pallas.qmatmul")

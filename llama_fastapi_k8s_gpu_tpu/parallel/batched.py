@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from ..models.config import ModelConfig
 from ..models.llama import forward, init_cache, prefill
+from ..obs.devtime import timed_jit
 from ..sampling.sample import PENALTY_WINDOW, sample_chain
 
 
@@ -46,6 +47,10 @@ def batched_prefill_jit(params, cfg: ModelConfig, tokens, lengths, caches):
     )(tokens, lengths, caches)
 
 
+batched_prefill_jit = timed_jit("batched_prefill", batched_prefill_jit,
+                                site="parallel.batched")
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "n_steps", "top_k"),
@@ -73,6 +78,11 @@ def batched_generate_chunk_jit(params, cfg: ModelConfig, state: dict, st: dict,
         return new_carry, tok
 
     return jax.lax.scan(one_step, state, None, length=n_steps)
+
+
+batched_generate_chunk_jit = timed_jit(
+    "batched_decode_chunk", batched_generate_chunk_jit,
+    site="parallel.batched")
 
 
 @functools.partial(
@@ -108,6 +118,11 @@ def batched_generate_chunk_perlane_jit(params, cfg: ModelConfig, state: dict,
     return jax.lax.scan(one_step, state, None, length=n_steps)
 
 
+batched_generate_chunk_perlane_jit = timed_jit(
+    "lane_decode_chunk", batched_generate_chunk_perlane_jit,
+    site="parallel.batched")
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "top_k"),
@@ -139,3 +154,8 @@ def batched_spec_verify_perlane_jit(params, cfg: ModelConfig, state: dict,
     new_state = {"cache": cache, "pos": pos, "token": tok,
                  "window": window, "wpos": wpos, "key": key}
     return new_state, toks, cnt
+
+
+batched_spec_verify_perlane_jit = timed_jit(
+    "lane_spec_verify", batched_spec_verify_perlane_jit,
+    site="parallel.batched")

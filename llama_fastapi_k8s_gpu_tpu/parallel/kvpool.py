@@ -56,6 +56,7 @@ import numpy as np
 
 from ..models.config import ModelConfig
 from ..models.llama import init_cache
+from ..obs.devtime import timed_jit
 
 logger = logging.getLogger(__name__)
 
@@ -100,6 +101,10 @@ def _store_pages_jit(arena: dict, ring: dict, page_ids, offset):
     return jax.tree.map(per_leaf, arena, ring)
 
 
+_store_pages_jit = timed_jit("kvpool_store", _store_pages_jit,
+                             site="parallel.kvpool")
+
+
 @functools.partial(jax.jit, donate_argnames=("arena",))
 def _store_lane_pages_jit(arena: dict, bcache: dict, lane, page_ids, offset):
     """As :func:`_store_pages_jit`, reading lane ``lane`` of a batched
@@ -117,6 +122,10 @@ def _store_lane_pages_jit(arena: dict, bcache: dict, lane, page_ids, offset):
     return jax.tree.map(per_leaf, arena, bcache)
 
 
+_store_lane_pages_jit = timed_jit("kvpool_lane_store", _store_lane_pages_jit,
+                                  site="parallel.kvpool")
+
+
 @functools.partial(jax.jit, donate_argnames=("ring",))
 def _restore_pages_jit(arena: dict, ring: dict, page_ids, offset):
     """Copy arena pages ``page_ids`` into ring token slots
@@ -131,11 +140,19 @@ def _restore_pages_jit(arena: dict, ring: dict, page_ids, offset):
     return jax.tree.map(per_leaf, arena, ring)
 
 
+_restore_pages_jit = timed_jit("kvpool_restore", _restore_pages_jit,
+                               site="parallel.kvpool")
+
+
 @functools.partial(jax.jit, donate_argnames=("arena",))
 def _upload_pages_jit(arena: dict, pages: dict, page_ids):
     """Write host-restored page stacks back into arena slots (spill tier
     restore path)."""
     return jax.tree.map(lambda al, p: al.at[page_ids].set(p), arena, pages)
+
+
+_upload_pages_jit = timed_jit("kvpool_upload", _upload_pages_jit,
+                              site="parallel.kvpool")
 
 
 # ---------------------------------------------------------------------------

@@ -48,6 +48,7 @@ except ImportError:                   # older jax: the experimental home, with
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
+from ..obs.devtime import timed_jit
 from ..ops.pallas.attention import DEFAULT_MASK_VALUE
 
 _local = threading.local()
@@ -249,7 +250,8 @@ def _sp_prefill_fn(mesh: Mesh, axis_name: str, cfg: ModelConfig):
         with ring_context(mesh, axis_name):
             return _prefill(params, cfg, tokens, length, cache)
 
-    return jax.jit(fn, donate_argnames=("cache",))
+    return timed_jit("sp_prefill", jax.jit(fn, donate_argnames=("cache",)),
+                     site="parallel.ring")
 
 
 @functools.lru_cache(maxsize=32)
@@ -262,7 +264,8 @@ def _sp_decode_fn(mesh: Mesh, axis_name: str, cfg: ModelConfig):
         with ring_context(mesh, axis_name):
             return _decode(params, cfg, token, pos, cache)
 
-    return jax.jit(fn, donate_argnames=("cache",))
+    return timed_jit("sp_decode_step", jax.jit(fn, donate_argnames=("cache",)),
+                     site="parallel.ring")
 
 
 def sp_prefill(params, cfg: ModelConfig, tokens, length, cache, mesh: Mesh,
@@ -292,7 +295,8 @@ def _sp_chunk_fn(mesh: Mesh, axis_name: str, cfg: ModelConfig,
         with ring_context(mesh, axis_name):
             return generate_chunk(params, cfg, state, st, n_steps, top_k)
 
-    return jax.jit(fn, donate_argnames=("state",))
+    return timed_jit("sp_decode_chunk", jax.jit(fn, donate_argnames=("state",)),
+                     site="parallel.ring")
 
 
 def sp_generate_chunk(params, cfg: ModelConfig, state: dict, st: dict,

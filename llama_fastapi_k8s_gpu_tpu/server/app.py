@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import math
 import time
 from datetime import datetime
 
@@ -40,7 +41,9 @@ from .asgikit import (
 
 import uuid
 
+from ..obs.devtime import DEVTIME
 from ..obs.logctx import access_logger, bind_request_id
+from ..obs.slo import SLOEngine
 from ..obs.trace import TRACER, Tracer
 from ..utils.config import Settings, get_settings
 from ..utils.faults import FAULTS
@@ -133,6 +136,13 @@ def create_app(engine=None, settings: Settings | None = None,
     app.state.engine = engine
     app.state.metrics = Metrics()
     app.state.tracer = tracer if tracer is not None else TRACER
+    #: SLO burn-rate engine over this app's metrics (obs/slo.py): /metrics
+    #: exports slo_burn_rate gauges, /debug/slo the full verdict
+    app.state.slo = SLOEngine(app.state.metrics)
+    #: devtime compile-event cursor: /metrics replays each compile event
+    #: into xla_compile_seconds exactly once per app (-1 = never read, so
+    #: a ring that overflowed before this app existed charges no drop)
+    app.state.devtime_cursor = -1
     app.state.ready = engine is not None
     #: pod health state machine (utils/health.py): STARTING until the
     #: engine is loaded; the watchdog moves it between READY/DEGRADED/DEAD
@@ -260,7 +270,11 @@ def create_app(engine=None, settings: Settings | None = None,
         if timings is None:
             timings = getattr(app.state.engine, "last_timings", None)
         if timings:
-            m.observe("engine_ttft_seconds", timings["ttft_s"])
+            # per-prefill-bucket TTFT series: the SLO engine evaluates each
+            # bucket separately, so a 32k-prompt violation cannot hide
+            # under a flood of short prompts (docs/SLO.md)
+            m.observe("engine_ttft_seconds", timings["ttft_s"],
+                      bucket=str(timings.get("bucket", 0)))
             if timings["tokens_per_sec"]:
                 m.observe("engine_decode_tokens_per_sec",
                           timings["tokens_per_sec"])
@@ -882,6 +896,23 @@ def create_app(engine=None, settings: Settings | None = None,
         m.set_gauge("trace_ring_used", tstats["ring_used"])
         m.set_gauge("traces_started_total", tstats["started_total"])
         m.set_gauge("traces_sampled_out_total", tstats["sampled_out_total"])
+        # compile/dispatch attribution (obs/devtime.py): per-program
+        # counters as snapshots, compile walls replayed into the histogram
+        # exactly once via the app's event cursor
+        for prog, c in DEVTIME.counters().items():
+            m.set_gauge("xla_compiles_total", c["compiles"], program=prog)
+            m.set_gauge("jit_dispatches_total", c["dispatches"],
+                        program=prog)
+        m.set_gauge("xla_recompile_storms_total", DEVTIME.storms_total)
+        cursor, events = DEVTIME.events_since(app.state.devtime_cursor)
+        app.state.devtime_cursor = cursor
+        for ev in events:
+            m.observe("xla_compile_seconds", ev["wall_s"],
+                      program=ev["program"])
+        m.set_gauge("xla_compile_events_dropped_total",
+                    DEVTIME.events_dropped)
+        # SLO burn rates over the series recorded above (obs/slo.py)
+        app.state.slo.export()
         return PlainTextResponse(m.render())
 
     # -- lfkt-obs debug surface (docs/OBSERVABILITY.md) --------------------
@@ -907,6 +938,54 @@ def create_app(engine=None, settings: Settings | None = None,
         remaining, tokens so far — the live answer to "what is this pod
         doing right now"."""
         return {"requests": app.state.tracer.inflight()}
+
+    @app.get("/debug/compiles")
+    async def debug_compiles():
+        """The devtime program registry (obs/devtime.py): every registered
+        jit program with its compile count, dispatch count, and the
+        static-shape signatures it compiled — the "what is this pod
+        recompiling" answer (docs/RUNBOOK.md recompile-storm runbook)."""
+        return DEVTIME.snapshot()
+
+    @app.get("/debug/slo")
+    async def debug_slo():
+        """The SLO verdict document (obs/slo.py; docs/SLO.md): per-SLO
+        multi-window burn rates with per-series detail, plus the devtime
+        recompile-storm state.  ``verdict`` is the pod's one-word answer:
+        ok | warn | breach."""
+        return app.state.slo.evaluate()
+
+    @app.get("/debug/profile")
+    async def debug_profile(request: Request):
+        """Bounded on-demand XProf capture (utils/tracing.py).  Opt-in:
+        403 until LFKT_PROFILE_DIR is set; 409 while a capture runs;
+        ``?seconds=`` clamps to the capture bounds.  The capture blocks a
+        worker thread, never the event loop."""
+        from urllib.parse import parse_qs
+
+        from ..utils.tracing import (
+            ProfileBusy,
+            ProfileDisabled,
+            capture_profile,
+        )
+
+        q = parse_qs(request.url.query)
+        try:
+            seconds = float(q.get("seconds", ["2.0"])[0])
+        except ValueError:
+            raise HTTPException(status_code=400,
+                                detail="seconds must be a number")
+        if not math.isfinite(seconds):
+            # nan/inf slide through min() clamps (nan<x is False) and
+            # would hold the exclusive capture lock for the full maximum
+            raise HTTPException(status_code=400,
+                                detail="seconds must be finite")
+        try:
+            return await asyncio.to_thread(capture_profile, seconds)
+        except ProfileDisabled as e:
+            raise HTTPException(status_code=403, detail=str(e))
+        except ProfileBusy as e:
+            raise HTTPException(status_code=409, detail=str(e))
 
     @app.get("/items/{item_id}")
     async def read_item(item_id: int):

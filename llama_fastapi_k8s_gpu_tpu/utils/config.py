@@ -307,8 +307,30 @@ KNOBS: dict[str, Knob] = _register(
          "persistent XLA compile cache (utils/jaxcache.py)", serving=True,
          default=""),
     Knob("LFKT_PROFILE_DIR", str,
-         "capture XProf traces per generation (utils/tracing.py)",
-         default=""),
+         "capture XProf traces per generation (utils/tracing.py) and via "
+         "GET /debug/profile", serving=True, default=""),
+    # -- lfkt-perf (obs/devtime.py + obs/slo.py; docs/SLO.md) --------------
+    Knob("LFKT_DEVTIME", bool,
+         "per-program compile/dispatch attribution (obs/devtime.py; "
+         "0 disarms the registry)", serving=True, default=True),
+    Knob("LFKT_RECOMPILE_BUDGET", int,
+         "distinct jit signatures per program before a recompile storm "
+         "is flagged", serving=True, default=32),
+    Knob("LFKT_SLO_TTFT_P95_S", float,
+         "SLO: TTFT bound (seconds) 95% of requests must beat, per "
+         "prefill bucket", serving=True, default=1.0),
+    Knob("LFKT_SLO_DECODE_FLOOR_TPS", float,
+         "SLO: decode tok/s floor 95% of requests must clear",
+         serving=True, default=10.0),
+    Knob("LFKT_SLO_ERROR_RATE", float,
+         "SLO: 5xx error-rate budget over each burn window",
+         serving=True, default=0.01),
+    Knob("LFKT_SLO_QUEUE_P95_S", float,
+         "SLO: admission-queue wait bound (seconds) at the 95th percentile",
+         serving=True, default=0.5),
+    Knob("LFKT_SLO_WINDOWS", str,
+         "SLO burn-rate windows, csv seconds (short,long)",
+         serving=True, default="300,3600"),
     # -- lfkt-obs (obs/trace.py; docs/OBSERVABILITY.md) --------------------
     Knob("LFKT_TRACE_SAMPLE", float,
          "fraction of requests traced (0 disarms the tracer)",

@@ -134,6 +134,27 @@ class Metrics:
             s.total += float(value)
             s.count += 1
 
+    # -- programmatic reads (obs/slo.py burn-rate evaluation) ------------
+    def snapshot(self) -> dict:
+        """Point-in-time copy of every series' raw storage:
+        ``{name: {labels_tuple: value | {"buckets", "sum", "count"}}}``.
+        The SLO engine diffs two snapshots to get windowed rates — the
+        histogram buckets here are cumulative-since-boot, so deltas over a
+        window are exact event counts, not samples."""
+        out: dict = {}
+        with self._lock:
+            for name, series in self._series.items():
+                metric = lookup(name)
+                per: dict = {}
+                for key, s in series.items():
+                    if metric.mtype == HISTOGRAM and not metric.prefix:
+                        per[key] = {"buckets": list(s.buckets),
+                                    "sum": s.total, "count": s.count}
+                    else:
+                        per[key] = s.value
+                out[name] = per
+        return out
+
     # -- exposition ------------------------------------------------------
     @staticmethod
     def _label_str(metric: Metric, key: tuple, extra: str = "") -> str:

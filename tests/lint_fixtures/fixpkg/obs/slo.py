@@ -1,0 +1,13 @@
+"""Planted PERF002 violations (lint/perf.py; see ../../README.md)."""
+
+
+class SLO:
+    def __init__(self, name, metric="", kind="", **kw):
+        self.name = name
+        self.metric = metric
+        self.kind = kind
+
+
+GOOD = SLO("good", metric="documented_total", kind="ratio")
+PREFIXED = SLO("fam", metric="family_live", kind="ratio")  # prefix family: fine
+BAD = SLO("phantom", metric="not_a_metric_total", kind="latency")  # PERF002

@@ -1,0 +1,48 @@
+"""Planted PERF001 violations (lint/perf.py; see ../README.md)."""
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+from .obs.devtime import register_program, timed_jit
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def unregistered_decorated(x, n):       # PERF001: decorator form
+    return x * n
+
+
+def unregistered_builder():             # PERF001: jax.jit call form
+    return jax.jit(lambda x: x + 1)
+
+
+def unregistered_kernel(x):             # PERF001: pallas_call form
+    return pl.pallas_call(lambda r, o: None, interpret=True)(x)
+
+
+@jax.jit
+def registered_decorated(x):            # fine: named in timed_jit below
+    return x + 2
+
+
+registered_decorated = timed_jit("registered", registered_decorated)
+
+
+def registered_builder():               # fine: wrapped at build time
+    return timed_jit("built", jax.jit(lambda x: x - 1))
+
+
+def inventory_kernel(x):                # fine: register_program names it
+    return pl.pallas_call(lambda r, o: None, interpret=True)(x)
+
+
+register_program("inventory_kernel", site="fixpkg.perfbad")
+
+
+def suppressed_builder():
+    return jax.jit(lambda x: x * 3)  # lfkt: noqa[PERF001] -- fixture: proves suppression works
+
+
+_refs = (unregistered_decorated, unregistered_builder, unregistered_kernel,
+         registered_builder, inventory_kernel, suppressed_builder)

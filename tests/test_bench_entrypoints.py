@@ -22,6 +22,8 @@ def _run(script, extra_env=None, timeout=900):
     assert lines, out.stderr[-2000:]
     parsed = json.loads(lines[-1])
     assert "metric" in parsed and "value" in parsed, parsed
+    # every emitted line is provenance-stamped (utils/provenance.py)
+    assert parsed.get("provenance", {}).get("schema") == 1, parsed
     return parsed, out
 
 
@@ -242,3 +244,145 @@ def test_synth_q4km_layouts_match_prep():
     for key in want:
         assert got[key].shape == want[key].shape, key
         assert got[key].dtype == want[key].dtype, key
+
+
+# ---------------------------------------------------------------------------
+# lfkt-perf (ISSUE 7): provenance stamps + the perf_gate regression sentinel
+# ---------------------------------------------------------------------------
+
+def _load_tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_provenance_stamp_schema(monkeypatch):
+    """utils/provenance.stamp(): the block every bench line now carries —
+    git commit of this checkout, a device kind, and the LFKT_* env
+    fingerprint whose hash changes iff a knob changes."""
+    from llama_fastapi_k8s_gpu_tpu.utils import provenance
+
+    monkeypatch.setenv("LFKT_BENCH_PRESET", "tiny")
+    s1 = provenance.stamp()
+    assert s1["schema"] == 1
+    assert len(s1["git_commit"]) == 40          # a real checkout commit
+    assert s1["device"].startswith(("cpu", "tpu", "gpu"))
+    assert s1["knobs"]["LFKT_BENCH_PRESET"] == "tiny"
+    assert len(s1["knob_hash"]) == 12
+    monkeypatch.setenv("LFKT_BENCH_PRESET", "other")
+    assert provenance.stamp()["knob_hash"] != s1["knob_hash"]
+    # run-placement knobs (port, dirs) are NOT part of the fingerprint —
+    # a rerun from another checkout/port must not read as config drift
+    monkeypatch.setenv("LFKT_BENCH_PRESET", "tiny")
+    monkeypatch.setenv("LFKT_PORT", "8099")
+    monkeypatch.setenv("LFKT_MODEL_DIR", "/tmp/elsewhere")
+    s3 = provenance.stamp()
+    assert s3["knob_hash"] == s1["knob_hash"]
+    assert "LFKT_PORT" not in s3["knobs"]
+    # schema validation accepts the real stamp...
+    cm = _load_tool("check_manifest")
+    assert cm.validate_schema(
+        "x.json", {"metric": "m[t]", "value": 1.0, "unit": "ms",
+                   "provenance": s1}) == []
+    # ...and names each broken field
+    broken = dict(s1, knobs={"NOT_LFKT": "x"}, git_commit="")
+    errs = cm.validate_schema(
+        "x.json", {"metric": "m[t]", "value": 1.0, "unit": "ms",
+                   "provenance": broken})
+    assert any("git_commit" in e for e in errs)
+    assert any("knobs" in e for e in errs)
+
+
+def test_bench_emit_result_stamps_provenance(tmp_path):
+    """bench.py's emit_result: every emitted line carries the stamp (unit
+    level — the full-engine smoke paths above already cost minutes)."""
+    import contextlib
+    import io
+
+    sys.path.insert(0, REPO)
+    from bench import emit_result
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        emit_result({"metric": "m[unit-test]", "value": 1.0, "unit": "ms"})
+    line = json.loads(buf.getvalue())
+    assert line["metric"] == "m[unit-test]"
+    assert line["provenance"]["schema"] == 1
+    assert line["provenance"]["git_commit"]
+
+
+def test_perf_gate_passes_banked_baselines():
+    """Acceptance: zero exit comparing the banked baselines to themselves
+    (the MANIFEST 'Perf gate baselines' table resolves and matches)."""
+    gate = _load_tool("perf_gate")
+    fresh = [os.path.join(REPO, "docs", "bench", a)
+             for a in gate.load_baseline_table().values()]
+    assert fresh, "MANIFEST must name perf-gate baselines"
+    assert gate.main(fresh) == 0
+
+
+def test_perf_gate_refuses_planted_regression(tmp_path):
+    """Acceptance: a planted regression (headline rate down 20%, TTFT up
+    40%) exits nonzero; a within-noise wiggle (−2%) passes."""
+    gate = _load_tool("perf_gate")
+    base_name = gate.load_baseline_table()["decode_tokens_per_sec_per_chip"]
+    base = json.load(open(os.path.join(REPO, "docs", "bench", base_name)))
+
+    regressed = dict(base, value=base["value"] * 0.8,
+                     ttft_ms_p50=base["ttft_ms_p50"] * 1.4)
+    p = tmp_path / "regressed.json"
+    p.write_text(json.dumps(regressed))
+    assert gate.main([str(p)]) == 1
+
+    wiggle = dict(base, value=base["value"] * 0.98)
+    p2 = tmp_path / "wiggle.json"
+    p2.write_text(json.dumps(wiggle))
+    assert gate.main([str(p2)]) == 0
+
+
+def test_perf_gate_comparability_guards(tmp_path):
+    """Device mismatch refuses the comparison (exit 2); knob-fingerprint
+    drift warns by default and refuses under --strict-knobs; an artifact
+    carrying an error field is always refused."""
+    gate = _load_tool("perf_gate")
+    base_name = gate.load_baseline_table()["decode_tokens_per_sec_per_chip"]
+    base_path = os.path.join(REPO, "docs", "bench", base_name)
+    base = json.load(open(base_path))
+
+    wrong_dev = dict(base, device="cpu:TFRT")
+    p = tmp_path / "dev.json"
+    p.write_text(json.dumps(wrong_dev))
+    assert gate.main([str(p)]) == 2
+
+    prov_a = dict(base, provenance={"schema": 1, "git_commit": "a" * 40,
+                                    "device": "tpu:x", "knobs": {},
+                                    "knob_hash": "aaaaaaaaaaaa"})
+    prov_b = dict(base, provenance={**prov_a["provenance"],
+                                    "knob_hash": "bbbbbbbbbbbb"})
+    pa = tmp_path / "a.json"
+    pb = tmp_path / "b.json"
+    pa.write_text(json.dumps(prov_a))
+    pb.write_text(json.dumps(prov_b))
+    assert gate.main([str(pa), "--baseline", str(pb)]) == 0        # warns
+    assert gate.main([str(pa), "--baseline", str(pb),
+                      "--strict-knobs"]) == 2
+
+    failed = dict(base, error="device fell over")
+    pf = tmp_path / "f.json"
+    pf.write_text(json.dumps(failed))
+    assert gate.main([str(pf)]) == 1
+
+
+def test_perf_gate_skips_unknown_tags_loudly(tmp_path):
+    """A fresh config with no exact-metric baseline is SKIPPED (exit 0,
+    reported) — never silently compared across configurations."""
+    gate = _load_tool("perf_gate")
+    rec = {"metric": "decode_tokens_per_sec_per_chip[tiny,novel-cfg]",
+           "value": 1.0, "unit": "tokens/sec/chip", "device": "cpu:x"}
+    p = tmp_path / "novel.json"
+    p.write_text(json.dumps(rec))
+    assert gate.main([str(p)]) == 0

@@ -106,6 +106,8 @@ def _fired(rule, path_part, suppressed=False):
     ("KER001", "kernbad.py", 1),    # pallas_call without interpret=
     ("KER002", "kernbad.py", 1),    # no probe, no fallback
     ("KER003", "kernbad.py", 1),    # call inside a block shape
+    ("PERF001", "perfbad.py", 3),   # decorator + jit-call + pallas_call forms
+    ("PERF002", "obs/slo.py", 1),   # SLO over a phantom metric family
     ("DEAD001", "deadbad.py", 1),   # totally_unused
     ("DEAD002", "deadbad.py", 1),   # phantom __all__ export
     ("LINT000", "noqabad.py", 1),   # noqa without reason
@@ -137,6 +139,7 @@ def test_host_only_code_not_flagged_by_jit_rules():
     ("CFG001", "cfgbad.py"),        # suppressed_read
     ("JIT001", "jitbad.py"),        # def-line noqa covers the body
     ("OBS001", "obsbad.py"),        # audited_total suppression
+    ("PERF001", "perfbad.py"),      # suppressed_builder's audited noqa
     ("DEAD001", "deadbad.py"),      # registry_hook getattr exemption
 ])
 def test_noqa_suppresses(rule, path_part):

@@ -51,9 +51,9 @@ def test_chart_env_vars_are_read_by_config():
     # Engine init), not the Settings loader
     cache_src = open(os.path.join(
         REPO, "llama_fastapi_k8s_gpu_tpu", "utils", "jaxcache.py")).read()
-    known = set(re.findall(r'"(LFKT_[A-Z_]+)"', cfg_src + cache_src))
+    known = set(re.findall(r'"(LFKT_[A-Z0-9_]+)"', cfg_src + cache_src))
     dep = open(os.path.join(REPO, "helm", "templates", "deployment.yaml")).read()
-    used = set(re.findall(r"name: (LFKT_[A-Z_]+)", dep))
+    used = set(re.findall(r"name: (LFKT_[A-Z0-9_]+)", dep))
     assert used, "deployment should set LFKT_* env vars"
     assert used <= known, f"chart sets env vars config.py never reads: {used - known}"
 

@@ -1,0 +1,206 @@
+"""Deterministic CPU perf pins (ISSUE 7): compile & dispatch budgets.
+
+Chip time is scarce; compile counts and dispatch counts are not — they
+are exact, device-independent integers the devtime registry
+(obs/devtime.py) measures identically on the CPU backend.  These tests
+pin, per engine flavor:
+
+- **warmup compiles exactly K programs** (named, counted): a new jit
+  entry point, a lost warmup shape, or a silent extra signature changes
+  K and fails here — on CPU, long before a chip session pays for it;
+- **steady state compiles nothing**: after warmup, requests re-dispatch
+  the warmed programs only (this pin found and now guards two real
+  holes: the sharded engines' chunk-2 donated-state resharding compile,
+  fixed by the two-chunk warmup, and the serial tail-chunk compile,
+  exercised deliberately below);
+- **each request dispatches exactly D per program** — an extra dispatch
+  per decode chunk is the launch/DMA overhead the kernel-looping roadmap
+  item exists to eliminate; it must never sneak in unmeasured.
+
+The pins run in ONE fresh subprocess: jit caches are process-global, so
+a suite that already warmed the module-level entry points would satisfy
+any compile count vacuously.  Shapes: tiny GGUF, n_ctx=128, buckets
+(32, 64, 128), decode_chunk=4, 8 virtual CPU devices (conftest's mesh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+         if "xla_force_host_platform_device_count" not in f]
+flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(flags)
+import json, sys, tempfile, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+from llama_fastapi_k8s_gpu_tpu.obs.devtime import DEVTIME
+from llama_fastapi_k8s_gpu_tpu.engine import (
+    ContinuousEngine, Engine, MeshEngine, SPEngine)
+
+path = tempfile.mktemp(suffix=".gguf")
+write_tiny_llama_gguf(path)
+MSGS = [{"role": "user", "content": "Say something."}]
+KW = dict(n_ctx=128, decode_chunk=4, max_gen_tokens=16,
+          prefill_buckets=(32, 64, 128))
+out = {}
+
+
+def snap():
+    return {k: (v["compiles"], v["dispatches"])
+            for k, v in DEVTIME.counters().items()
+            if v["compiles"] or v["dispatches"]}
+
+
+def delta(a, b):
+    return {k: (b[k][0] - a.get(k, (0, 0))[0], b[k][1] - a.get(k, (0, 0))[1])
+            for k in b if b[k] != a.get(k, (0, 0))}
+
+
+# -- serial ---------------------------------------------------------------
+DEVTIME.reset()
+eng = Engine(path, prefix_cache=False, **KW)
+eng.warmup()
+w = snap()
+out["serial_warmup"] = w
+eng.create_chat_completion(MSGS, temperature=0.0, max_tokens=9)
+a = snap()
+out["serial_req"] = delta(w, a)
+eng.create_chat_completion(MSGS, temperature=0.0, max_tokens=9)
+out["serial_req2"] = delta(a, snap())
+
+# -- mesh-batched ---------------------------------------------------------
+DEVTIME.reset()
+eng = MeshEngine(path, dp=2, tp=2, batch_size=2, **KW)
+eng.warmup()
+w = snap()
+out["mesh_warmup"] = w
+eng.create_chat_completions([MSGS, MSGS], temperature=0.0, max_tokens=9)
+out["mesh_req"] = delta(w, snap())
+
+# -- sequence-parallel ----------------------------------------------------
+DEVTIME.reset()
+eng = SPEngine(path, sp=2, tp=1, **KW)
+eng.warmup()
+w = snap()
+out["sp_warmup"] = w
+eng.create_chat_completion(MSGS, temperature=0.0, max_tokens=9)
+out["sp_req"] = delta(w, snap())
+
+# -- continuous ------------------------------------------------------------
+DEVTIME.reset()
+ceng = ContinuousEngine(path, dp=2, tp=2, batch_size=4, **KW)
+ceng.warmup()
+w = snap()
+out["cont_warmup"] = w
+ceng.submit(MSGS, temperature=0.0, max_tokens=8).result(timeout=120)
+time.sleep(0.5)         # let the pipelined in-flight chunk land
+out["cont_req"] = delta(w, snap())
+ceng.shutdown()
+
+print("PINS " + json.dumps(out))
+"""
+
+
+@pytest.fixture(scope="module")
+def pins():
+    proc = subprocess.run([sys.executable, "-c", _SCRIPT], cwd=REPO,
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = next(ln for ln in proc.stdout.splitlines()
+                if ln.startswith("PINS "))
+    return {k: {p: tuple(v) for p, v in progs.items()}
+            for k, progs in json.loads(line[5:]).items()}
+
+
+def _compiles(d):
+    return {k: v[0] for k, v in d.items() if v[0]}
+
+
+# ---------------------------------------------------------------------------
+# warmup compiles exactly K programs, by name and count
+# ---------------------------------------------------------------------------
+
+def test_serial_warmup_compile_budget(pins):
+    # prefill: the warmup prompt's bucket (64) + the remaining bucket walk
+    # (128; bucket 32 never runs monolithically for this prompt) = 2
+    # programs; decode_chunk: ONE n_steps=4 signature covers both warmup
+    # chunks; first_sample: 1
+    assert _compiles(pins["serial_warmup"]) == {
+        "prefill": 2, "first_sample": 1, "decode_chunk": 1}
+
+
+def test_mesh_warmup_compile_budget(pins):
+    # batched_prefill: 3 buckets; batched_decode_chunk: 2 (chunk 1 against
+    # the device_put state sharding + chunk 2 against the donated jit
+    # output sharding — the hole the two-chunk warmup closes); plus the
+    # serial streaming path (prefill 3 incl. the 32-bucket 'hi' prompt,
+    # decode_chunk 2 for the same sharding pair)
+    assert _compiles(pins["mesh_warmup"]) == {
+        "batched_prefill": 3, "batched_first_sample": 1,
+        "batched_decode_chunk": 2,
+        "prefill": 3, "first_sample": 1, "decode_chunk": 2}
+
+
+def test_sp_warmup_compile_budget(pins):
+    assert _compiles(pins["sp_warmup"]) == {
+        "sp_prefill": 3, "first_sample": 1, "sp_decode_chunk": 2}
+
+
+def test_continuous_warmup_compile_budget(pins):
+    # prefill_chunk: 4 admission/suffix slice shapes; lane_write: 2 cache1
+    # bucket shapes; lane_decode_chunk: the sharding pair; lane_cache_copy:
+    # the lane-prefix snapshot program
+    assert _compiles(pins["cont_warmup"]) == {
+        "prefill_chunk": 4, "first_sample": 1, "lane_decode_chunk": 2,
+        "lane_write": 2, "lane_cache_copy": 1}
+
+
+# ---------------------------------------------------------------------------
+# steady state: zero compiles, exactly D dispatches per request
+# ---------------------------------------------------------------------------
+
+def test_serial_request_dispatch_budget(pins):
+    # max_tokens=9 = first sample + two FULL decode chunks of 4: one
+    # prefill dispatch, one first-sample, exactly two chunk dispatches —
+    # and zero compiles, twice in a row
+    want = {"prefill": (0, 1), "first_sample": (0, 1),
+            "decode_chunk": (0, 2)}
+    assert pins["serial_req"] == want
+    assert pins["serial_req2"] == want
+
+
+def test_mesh_request_dispatch_budget(pins):
+    assert pins["mesh_req"] == {
+        "batched_prefill": (0, 1), "batched_first_sample": (0, 1),
+        "batched_decode_chunk": (0, 2)}
+
+
+def test_sp_request_dispatch_budget(pins):
+    assert pins["sp_req"] == {
+        "sp_prefill": (0, 1), "first_sample": (0, 1),
+        "sp_decode_chunk": (0, 2)}
+
+
+def test_continuous_request_budget(pins):
+    d = pins["cont_req"]
+    # zero compiles anywhere: admission, lane write, decode, harvest
+    assert all(c == 0 for c, _ in d.values()), d
+    assert d.get("prefill_chunk") == (0, 1)
+    assert d.get("lane_write") == (0, 1)
+    assert d.get("first_sample") == (0, 1)
+    # 8 tokens = 2 chunks; the pipelined scheduler may have one extra
+    # in-flight wave dispatched at harvest time (bounded, never compiled)
+    chunks = d.get("lane_decode_chunk", (0, 0))[1]
+    assert 2 <= chunks <= 4, d

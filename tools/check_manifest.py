@@ -5,7 +5,12 @@ Verifies, without touching any device:
      JSON (non-empty);
   2. every `*_20??-??-??.json` cited in docs/PERF.md exists in docs/bench/;
   3. every JSON in docs/bench/ has a MANIFEST row (no orphan evidence);
-  4. no 0-byte or `_tmp.*` files are tracked.
+  4. no 0-byte or `_tmp.*` files are tracked;
+  5. metric-bearing artifacts follow the bench schema ("metric" str,
+     numeric "value", "unit" str), and any `provenance` stamp
+     (utils/provenance.py — mandatory on all NEW artifacts; the
+     pre-lfkt-perf corpus predates it) validates: schema version, git
+     commit, device kind, and the LFKT_* knob fingerprint.
 
 Exit 0 clean; exit 1 with a line per violation.
 """
@@ -19,6 +24,42 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(ROOT, "docs", "bench")
+
+
+def validate_schema(name: str, doc) -> list[str]:
+    """Bench-artifact schema violations for one parsed JSON document
+    (top-level object, list, or a JSON-lines record)."""
+    bad: list[str] = []
+    records = doc if isinstance(doc, list) else [doc]
+    for rec in records:
+        if not isinstance(rec, dict):
+            bad.append(f"{name}: record is not a JSON object")
+            continue
+        if "metric" in rec:
+            if not isinstance(rec["metric"], str) or not rec["metric"]:
+                bad.append(f"{name}: non-string 'metric'")
+            if not isinstance(rec.get("value"), (int, float)):
+                bad.append(f"{name}: metric record without numeric 'value'")
+            if not isinstance(rec.get("unit"), str):
+                bad.append(f"{name}: metric record without string 'unit'")
+        prov = rec.get("provenance")
+        if prov is None:
+            continue                # pre-provenance corpus: stamp optional
+        if not isinstance(prov, dict):
+            bad.append(f"{name}: 'provenance' is not an object")
+            continue
+        if prov.get("schema") != 1:
+            bad.append(f"{name}: provenance schema != 1")
+        for field in ("git_commit", "device", "knob_hash"):
+            if not isinstance(prov.get(field), str) or not prov.get(field):
+                bad.append(f"{name}: provenance missing {field}")
+        knobs = prov.get("knobs")
+        if not isinstance(knobs, dict) or not all(
+                isinstance(k, str) and k.startswith("LFKT_")
+                and isinstance(v, str) for k, v in knobs.items()):
+            bad.append(f"{name}: provenance 'knobs' must map LFKT_* names "
+                       "to strings")
+    return bad
 
 
 def main() -> int:
@@ -36,9 +77,11 @@ def main() -> int:
             bad.append(f"MANIFEST row has no file: {f}")
             continue
         try:
-            json.load(open(p))
+            doc = json.load(open(p))
         except Exception as e:  # noqa: BLE001
             bad.append(f"unparseable artifact: {f} ({e})")
+            continue
+        bad.extend(validate_schema(f, doc))
     for f in sorted(cited - rows):
         bad.append(f"PERF.md cites artifact missing from MANIFEST: {f}")
     for f in sorted(cited - on_disk):
