@@ -804,7 +804,17 @@ class ContinuousEngine(MeshEngine):
         if lease is None:      # raced an eviction / spill-restore failed
             return 0, None
         if pspan is not None:
-            pspan.set(reused_pages=len(lease.page_ids), matched_tokens=i)
+            # guarded: between acquire and the handoff below, a raising
+            # span setter is the ONE thing that could leak the pinned
+            # pages — _begin_admission's cleanup releases its own `lease`
+            # local, which is still None while this call is on the stack
+            # (found by lfkt-lint RES001; regression-pinned in
+            # tests/test_kv_paged_engines.py)
+            try:
+                pspan.set(reused_pages=len(lease.page_ids),
+                          matched_tokens=i)
+            except Exception:  # noqa: BLE001 — telemetry must never pin pages
+                pass
         return r, lease
 
     def _release_adm_lease(self, adm) -> None:

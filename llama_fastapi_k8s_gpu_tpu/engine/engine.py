@@ -382,7 +382,11 @@ class Engine:
             paged = False
         self._kv_paged = paged
         #: the in-flight request's pinned pool pages (exactly one live
-        #: lease: the serial engines generate one request at a time)
+        #: lease: the serial engines generate one request at a time).
+        #: Lease lifecycle — acquire in _paged_reuse, store here
+        #: (the handoff), release in _drop_lease on every exit incl.
+        #: exceptions — is machine-checked by lfkt-lint RES001
+        #: (docs/LINT.md), the PR-6 leak class made static.
         self._paged_lease = None
         if paged:
             from ..parallel.kvpool import KVPool

@@ -31,14 +31,31 @@ This package checks them at test time, on CPU, stdlib-``ast`` only:
                       registered with the devtime compile/dispatch
                       registry (obs/devtime.py), and every SLO references
                       a cataloged metric family (obs/slo.py).
+- :mod:`.resources` — RES001-003: resource lifecycle over the CFG —
+                      leases/handles/futures and bare lock acquires must
+                      release or hand off on every path including
+                      exception edges (``# lfkt: transfers[...]`` is the
+                      handoff annotation); use-after-release.
+- :mod:`.donation`  — DON001-002: donated-buffer safety at jit call
+                      sites: reads of a donated value after dispatch and
+                      stale aliases that outlive it.
+- :mod:`.degrade`   — EXC001: ``# lfkt: degrades[attr]`` functions must
+                      set their fallback attribution in every swallowing
+                      ``except`` path.
 - :mod:`.deadcode`  — DEAD001-002: unreferenced module-level functions and
                       bogus ``__all__`` entries.
 
+The RES/DON/EXC families run on :mod:`.cfg` — statement-level control-
+flow graphs with exception edges plus a generic forward may/must
+dataflow solver (the v2 substrate; authoring guide in docs/LINT.md).
+
 Run ``python -m llama_fastapi_k8s_gpu_tpu.lint`` (exit 1 on findings,
 ``--json`` for machine-readable output), ``tools/lint_report.py`` for a
-per-rule table, or the tier-1 tests in tests/test_lint.py.  Suppress a
-finding with ``# lfkt: noqa[<RULE>] -- reason`` (the reason is mandatory;
-unknown rule IDs are themselves findings).  Rule catalog: docs/LINT.md.
+per-rule table (``--baseline`` for the rule-tightening ratchet),
+``tools/ci_gate.py`` for the aggregated repo gate, or the tier-1 tests
+in tests/test_lint.py.  Suppress a finding with
+``# lfkt: noqa[<RULE>] -- reason`` (the reason is mandatory; unknown
+rule IDs are themselves findings).  Rule catalog: docs/LINT.md.
 """
 
 from .core import Finding, all_rules, run_lint  # noqa: F401

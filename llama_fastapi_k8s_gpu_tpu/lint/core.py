@@ -156,11 +156,12 @@ def _py_files(root: str) -> list[str]:
 
 def _checkers() -> list[tuple[dict, Callable[[Context], list[Finding]]]]:
     # imported lazily so a syntax error in one checker names itself cleanly
-    from . import configreg, deadcode, jit, kernels, locks, obsreg, perf
+    from . import (configreg, deadcode, degrade, donation, jit, kernels,
+                   locks, obsreg, perf, resources)
 
     return [(mod.RULES, mod.check)
             for mod in (locks, jit, configreg, obsreg, kernels, perf,
-                        deadcode)]
+                        resources, donation, degrade, deadcode)]
 
 
 def all_rules() -> dict[str, str]:

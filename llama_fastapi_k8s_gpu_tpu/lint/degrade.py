@@ -1,0 +1,146 @@
+"""EXC001: degrade-path obligations for swallowed failures.
+
+KER002 proves a kernel module HAS a fallback; nothing proved the fallback
+paths ATTRIBUTE themselves.  The contract (ops/pallas/probe.py,
+dequant.py's ``_FORCE_HOST`` latch): when a function swallows a
+lowering/compile error and degrades to a slower path, it must record the
+degrade — otherwise a pod silently serves the slow path forever and every
+dashboard says "healthy".
+
+A function opts in with a def-line annotation naming the attribution it
+owes (a latch global, a ``self.<attr>``, a config field):
+
+```python
+def device_dequant(...):  # lfkt: degrades[_FORCE_HOST]
+```
+
+**EXC001** then fires when
+
+- any ``except`` handler in the function can complete WITHOUT raising
+  (it swallows) while some path through it never writes every named
+  attribution — checked over the handler-body CFG with a must-analysis,
+  so a write hidden under only one branch of the handler still fires; or
+- the annotation names an attribution the function never writes at all
+  (a typo'd registry checks nothing — the LOCK004 principle).
+
+Handlers that re-raise on every path owe nothing (the failure is not
+swallowed).  Suppress per-handler with a line noqa when a specific
+handler is exempt for a structural reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .cfg import build_cfg, solve_forward
+from .core import Context, Finding, Source
+
+RULES = {
+    "EXC001": "`# lfkt: degrades[attr]` function swallows an exception "
+              "without setting its fallback attribution on every path",
+}
+
+_DEGRADES_RE = re.compile(r"#\s*lfkt:\s*degrades\[([\w,\s]*)\]")
+
+
+def _degrades_marker(src: Source, fn) -> set[str]:
+    body_start = fn.body[0].lineno if fn.body else fn.lineno
+    out: set[str] = set()
+    for line in src.lines[fn.lineno - 1: body_start]:
+        for m in _DEGRADES_RE.finditer(line):
+            out.update(x.strip() for x in m.group(1).split(",") if x.strip())
+    return out
+
+
+def _writes_in(stmt: ast.stmt, attrs: set[str]) -> set[str]:
+    """Attributions written by this statement: an assign whose target's
+    terminal name matches (``_FORCE_HOST = True``, ``self.attn_impl = x``,
+    ``cfg.attn_impl = x``)."""
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out: set[str] = set()
+    for t in targets:
+        for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+            name = None
+            if isinstance(el, ast.Name):
+                name = el.id
+            elif isinstance(el, ast.Attribute):
+                name = el.attr
+            if name in attrs:
+                out.add(name)
+    return out
+
+
+def _own_handlers(fn) -> list[ast.ExceptHandler]:
+    """Except handlers lexically in ``fn``, skipping nested defs (their
+    handlers belong to their own annotated function, if any)."""
+    out: list[ast.ExceptHandler] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.ExceptHandler):
+                out.append(child)
+            walk(child)
+
+    walk(fn)
+    return out
+
+
+def check(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    for src in ctx.sources:
+        path = ctx.display_path(src)
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            required = _degrades_marker(src, fn)
+            if not required:
+                continue
+            # sanity: every named attribution is written SOMEWHERE in the
+            # function (otherwise the annotation checks nothing)
+            written_anywhere: set[str] = set()
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, ast.stmt):
+                    written_anywhere |= _writes_in(stmt, required)
+            ghost = required - written_anywhere
+            if ghost:
+                out.append(Finding(
+                    "EXC001", path, fn.lineno,
+                    f"degrades[{', '.join(sorted(ghost))}] names an "
+                    f"attribution {fn.name} never sets — typo'd "
+                    "annotations check nothing"))
+            required = required & written_anywhere
+            if not required:
+                continue
+            for handler in _own_handlers(fn):
+                cfg = build_cfg(handler.body)
+
+                def flow(node, state, _req=required):
+                    stmt = node.stmt
+                    if stmt is None:
+                        return {"*": state}
+                    done = state | frozenset(_writes_in(stmt, _req))
+                    # the write happened iff the statement completed
+                    return {"*": done, "exc": state}
+
+                IN = solve_forward(cfg, frozenset(), flow,
+                                   lambda a, b: a & b)
+                at_exit = IN.get(cfg.exit)
+                if at_exit is None:
+                    continue        # every path re-raises: not swallowed
+                missing = required - at_exit
+                if missing:
+                    out.append(Finding(
+                        "EXC001", path, handler.lineno,
+                        f"this handler can swallow the failure without "
+                        f"setting {', '.join(sorted(missing))} on every "
+                        f"path — the degrade would be unattributed "
+                        f"(# lfkt: degrades[...] on {fn.name})"))
+    return out

@@ -7,7 +7,10 @@ a chunk of ``n_steps`` sampled tokens per dispatch (checks stop conditions,
 streams text out), so per-token host↔device round-trips — the classic TPU
 decode-latency killer — are amortized away.  The KV cache and generation
 state are donated across chunks, so decode is allocation-free at steady
-state.
+state.  The ``donate_argnames`` declarations below are the source of
+truth for lfkt-lint's DON donor registry: a caller that reads the
+donated cache/state after dispatch (or keeps a stale alias) fails
+tier-1 statically (DON001-002, docs/LINT.md).
 """
 
 from __future__ import annotations

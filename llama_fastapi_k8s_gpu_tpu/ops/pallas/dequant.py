@@ -333,7 +333,8 @@ def _host_fallback(buf: np.ndarray, ggml_type: GGMLType, n: int,
 
 
 def device_dequant(buf: np.ndarray, ggml_type: GGMLType, n: int,
-                   dtype=jnp.float32, interpret: bool | None = None) -> jax.Array:
+                   dtype=jnp.float32,
+                   interpret: bool | None = None) -> jax.Array:  # lfkt: degrades[_FORCE_HOST]
     """Flat raw bytes → (n,) device array; falls back to the numpy codec
     (+ upload) for formats without a device kernel (F16/F32/BF16/Q4_0) and
     for ALL tensors once a device kernel fails to lower (new libtpu /

@@ -6,6 +6,11 @@ NamedShardings place the batch on the ``dp`` mesh axis and the model on
 the TPU-native replacement for the reference's "4 independent single-GPU
 pods" data parallelism (SURVEY.md §2A), and the basis of the v5e-4
 "concurrent /response load" config in BASELINE.json.
+
+Every entry point here donates its ``state``/``caches`` pytree: callers
+own the rebind-from-result contract, machine-checked at every call site
+by lfkt-lint DON001-002 (the donor registry is scraped from these
+``donate_argnames`` declarations — docs/LINT.md).
 """
 
 from __future__ import annotations

@@ -41,6 +41,13 @@ Compiled-shape bound: page moves dispatch in groups of at most
 ``_GROUP`` pages with traced offsets/ids, so the whole pool compiles at
 most ``2 * _GROUP`` small copy programs per cache layout — page ops are
 NOT part of the engines' warmed serving set and compile on first use.
+
+Machine-checked contracts (lfkt-lint v2, docs/LINT.md): every caller of
+:meth:`KVPool.acquire` must release or hand off the lease on every path
+(RES001 — the PR-6 leak class), and the donating copy jits below feed
+the DON donor registry — ``restore``'s ring parameter is donated
+transitively, so engine call sites must rebind or drop their ref across
+the call (DON001/DON002).
 """
 
 from __future__ import annotations

@@ -23,7 +23,11 @@ sharded over ``tp`` inside the ring):
 Model integration: ``attn_impl="ring"`` in ModelConfig routes
 ``models/llama.py`` attention here; :func:`sp_prefill` / :func:`sp_decode_step`
 wrap the jit'd model entry points with the ring context (mesh + axis name,
-needed at trace time).
+needed at trace time).  The ``_sp_*_fn`` factories below are the
+jit-factory form of lfkt-lint's DON donor registry (a donating jit over
+a nested def, returned from an lru_cached builder): the wrapper
+functions donate their cache/state transitively, and call sites are
+held to the rebind contract (DON001-002, docs/LINT.md).
 """
 
 from __future__ import annotations

@@ -196,10 +196,10 @@ def create_app(engine=None, settings: Settings | None = None,
                     # streams ride scheduler lanes concurrently with batched
                     # requests; each holds an inflight permit so the bounded
                     # queue (503) stays the back-pressure surface for them too
-                    await app.state.inflight.acquire()
+                    await app.state.inflight.acquire()  # lfkt: transfers[inflight] -- permit released in _stream_task's finally
                     _spawn(_stream_task(rd))
                 else:
-                    await app.state.inflight.acquire()
+                    await app.state.inflight.acquire()  # lfkt: transfers[inflight] -- permit released in _forward_to_scheduler's finally
                     _spawn(_forward_to_scheduler(rd))
                 queue.task_done()
                 continue
@@ -455,7 +455,7 @@ def create_app(engine=None, settings: Settings | None = None,
                     sub_kw["deadline"] = rd.get("deadline")
                 if app.state.engine_kw.get("submit_trace"):
                     sub_kw["trace"] = rd.get("trace")
-                engine_fut = engine.submit(
+                engine_fut = engine.submit(  # lfkt: transfers[engine_fut] -- the scheduler owns the lane: it resolves/reclaims the future via its _items registry even when a failure here skips the await (PR-2 semantics)
                     messages,
                     temperature=settings.temperature,
                     top_p=settings.top_p,

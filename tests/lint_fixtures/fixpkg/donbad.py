@@ -1,0 +1,68 @@
+"""Planted DON001-002 violations (lint/donation.py; see ../README.md).
+
+``step`` mirrors the engines' donating jit entry points; ``DonBad``'s
+methods replay the call-site idioms — the rebind contract, the stale
+alias, and the stash-on-self trap the donation rules exist to catch.
+"""
+
+import functools
+
+import jax
+
+from .obs.devtime import timed_jit
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def step(params, state):
+    return state
+
+
+step = timed_jit("don_step", step)
+
+
+class DonBad:
+    def __init__(self):
+        self._state = {"pos": 0}
+        self._params = {}
+        self._snap = None
+
+    # -- planted violations ---------------------------------------------
+    def read_after_donate(self):
+        out = step(self._params, self._state)   # donates self._state
+        n = self._state["pos"]                  # DON001: use-after-donate
+        return out, n
+
+    def alias_read_after_donate(self):
+        snap = self._state
+        self._state = step(self._params, self._state)
+        return snap["pos"]                      # DON002: stale alias read
+
+    def stash_then_donate(self, cache):
+        self._snap = cache
+        out = step(self._params, cache)         # DON002: self._snap holds
+        return out                              # the dead buffer at exit
+
+    # -- clean shapes (must NOT fire) -----------------------------------
+    def rebind_ok(self):
+        self._state = step(self._params, self._state)   # fine: rebound
+        return self._state
+
+    def rebind_loop_ok(self, n):
+        state = self._state
+        for _ in range(n):
+            state = step(self._params, state)   # fine: donate-and-rebind
+        self._state = state
+        return state
+
+    def drop_ref_ok(self):
+        # the PR-6 restore hardening idiom: drop the attr ref across the
+        # donating call so a mid-copy failure cannot leave a dead buffer
+        state, self._state = self._state, None
+        self._state = step(self._params, state)
+        return self._state
+
+    # -- suppression audit ----------------------------------------------
+    def suppressed_read(self):
+        out = step(self._params, self._state)
+        n = self._state["pos"]  # lfkt: noqa[DON001] -- fixture: proves suppression works
+        return out, n

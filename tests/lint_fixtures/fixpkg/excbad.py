@@ -1,0 +1,38 @@
+"""Planted EXC001 violations (lint/degrade.py; see ../README.md)."""
+
+
+class DegradeBad:
+    def __init__(self):
+        self.attn_impl = "pallas"
+
+    def _compile(self, x):
+        return x
+
+    # -- planted violations ---------------------------------------------
+    def partial_attribution(self, x):  # lfkt: degrades[attn_impl]
+        try:
+            return self._compile(x)
+        except Exception:               # EXC001: one branch swallows the
+            if x:                       # failure without attribution
+                self.attn_impl = "xla"
+            return None
+
+    def ghost_annotation(self, x):  # lfkt: degrades[no_such_attr]
+        return x                        # EXC001: names an attr never set
+
+    # -- clean shapes (must NOT fire) -----------------------------------
+    def full_attribution(self, x):  # lfkt: degrades[attn_impl]
+        try:
+            return self._compile(x)
+        except Exception:               # fine: every swallowing path sets it
+            self.attn_impl = "xla"
+            return None
+
+    def reraise_ok(self, x):  # lfkt: degrades[attn_impl]
+        if x is None:
+            self.attn_impl = "xla"      # the structural probe path
+            return None
+        try:
+            return self._compile(x)
+        except Exception:
+            raise                       # fine: the failure is not swallowed

@@ -1,0 +1,119 @@
+"""Planted RES001-003 violations (lint/resources.py; see ../README.md).
+
+``pr6_unpin_removed`` is the acceptance-criterion twin: the SAME code as
+``pr6_hardened`` with the ``finally:`` release deleted — the scratch-copy
+"disable one PR-6 hardening fix" demonstration, proving the RES family
+would have caught the original leak class (acquire → raise before
+release) without mutating the real package.
+"""
+
+import threading
+
+
+class Leaky:
+    def __init__(self, pool):
+        self._pool = pool
+        self._lock = threading.Lock()
+        self._slot = None
+        self._cap = 4
+
+    def work(self):
+        return 1
+
+    # -- planted violations ---------------------------------------------
+    def leak_on_raise(self, ids, n):
+        lease = self._pool.acquire(ids, 128)    # RES001: raise before release
+        if n > self._cap:
+            raise ValueError("over budget")
+        self._pool.release(lease)
+
+    def leak_on_early_return(self, ids, flag):
+        lease = self._pool.acquire(ids, 128)    # RES001: early return drops it
+        if flag:
+            return None
+        self._pool.release(lease)
+        return True
+
+    def pr6_hardened(self, ids):
+        # the PR-6 post-review shape: every path (device-copy failure
+        # included) unpins — fine: finally releases
+        lease = self._pool.acquire(ids, 128)
+        try:
+            return self._pool.restore(lease, None)
+        finally:
+            self._pool.release(lease)
+
+    def pr6_unpin_removed(self, ids):
+        # the same function with the `finally: unpin` disabled
+        lease = self._pool.acquire(ids, 128)    # RES001: PR-6 leak shape
+        out = self._pool.restore(lease, None)
+        self._pool.release(lease)
+        return out
+
+    def lock_leak(self):
+        self._lock.acquire()                    # RES002: work() may raise
+        self.work()
+        self._lock.release()
+
+    def use_after_release(self, ids):
+        lease = self._pool.acquire(ids, 128)
+        self._pool.release(lease)
+        return lease.tokens                     # RES003: released above
+
+    # -- clean shapes (must NOT fire) -----------------------------------
+    def lock_conditional_ok(self):
+        if not self._lock.acquire(blocking=False):
+            return False                        # fine: conditional acquire
+        try:
+            self.work()
+        finally:
+            self._lock.release()
+        return True
+
+    def lock_with_ok(self):
+        with self._lock:
+            return self.work()                  # fine: with manages it
+
+    def handoff_store_ok(self, ids):
+        lease = self._pool.acquire(ids, 128)    # fine: stored on self
+        self._slot = lease
+
+    def handoff_return_ok(self, ids):
+        n = len(ids)
+        lease = self._pool.acquire(ids, 128)    # fine: returned in a tuple
+        return n, lease
+
+    def handoff_annotated_ok(self, ids):
+        lease = self._pool.acquire(ids, 128)  # lfkt: transfers[lease] -- fixture: a registered callee takes ownership
+        self.work()
+
+    def none_guard_ok(self, ids):
+        lease = self._pool.acquire(ids, 128)    # fine: None branch exits
+        if lease is None:
+            return 0
+        self._slot = lease
+        return lease.tokens
+
+    def bind_then_with_ok(self, path):
+        fh = open(path)                         # fine: with closes it
+        with fh:
+            return fh.read()
+
+    def branch_release_read_ok(self, ids, cond):
+        lease = self._pool.acquire(ids, 128)
+        if cond:
+            self._slot = lease
+        else:
+            self._pool.release(lease)
+        return lease.tokens                     # fine: not released on EVERY path
+
+    # -- suppression audit ----------------------------------------------
+    def suppressed_leak(self, ids):
+        lease = self._pool.acquire(ids, 128)  # lfkt: noqa[RES001] -- fixture: proves suppression works
+        self.work()
+
+    def unaudited_transfer(self, ids):
+        # a reason-less transfers still discharges (like a reason-less
+        # noqa still suppressing) but is itself a LINT000 finding
+        lease = self._pool.acquire(ids, 128)  # lfkt: transfers[lease]
+        self.work()

@@ -289,3 +289,31 @@ def test_serial_restore_failure_does_not_poison_cache(model_path,
     r = eng.create_chat_completion(msgs2, temperature=0.0, max_tokens=8)
     assert r["choices"][0]["message"]["content"]
     assert r["lfkt_timings"]["prefix_reused_tokens"] > 0
+
+
+def test_continuous_reuse_survives_poisoned_span(model_path):
+    """lfkt-lint RES001 regression (ISSUE 8): a raising span setter inside
+    ``_paged_admission_reuse`` sat between ``pool.acquire`` and the lease
+    handoff — the one statement whose failure would leak the pinned pages
+    for the life of the process (``_begin_admission``'s cleanup releases
+    its own ``lease`` local, still None while the helper is on the stack).
+    The span set is now guarded: the hit proceeds, nothing stays pinned."""
+    eng = ContinuousEngine(model_path, dp=1, tp=1, batch_size=2,
+                           **BASE_KW, **PAGED_KW)
+    try:
+        msgs, _ = _convo()
+        eng.submit(msgs, temperature=0.0, max_tokens=8).result()
+        ids = eng.tokenize_messages(msgs)
+        assert eng._kvpool.match_len(ids) >= eng._paged_align
+
+        class PoisonedSpan:
+            def set(self, **kw):
+                raise RuntimeError("poisoned span setter")
+
+        r, lease = eng._paged_admission_reuse(ids, PoisonedSpan())
+        assert r > 0 and lease is not None, \
+            "the radix hit must survive a failing span setter"
+        eng._kvpool.release(lease)
+        assert eng._kvpool.occupancy()["pages_pinned"] == 0
+    finally:
+        eng.shutdown()

@@ -108,9 +108,16 @@ def _fired(rule, path_part, suppressed=False):
     ("KER003", "kernbad.py", 1),    # call inside a block shape
     ("PERF001", "perfbad.py", 3),   # decorator + jit-call + pallas_call forms
     ("PERF002", "obs/slo.py", 1),   # SLO over a phantom metric family
+    ("RES001", "resbad.py", 3),     # raise-path + early-return + PR-6 shape
+    ("RES002", "resbad.py", 1),     # lock.acquire without guaranteed release
+    ("RES003", "resbad.py", 1),     # use-after-release
+    ("DON001", "donbad.py", 1),     # read of donated attr after dispatch
+    ("DON002", "donbad.py", 2),     # stale alias read + stash-on-self exit
+    ("EXC001", "excbad.py", 2),     # swallowing handler + ghost annotation
     ("DEAD001", "deadbad.py", 1),   # totally_unused
     ("DEAD002", "deadbad.py", 1),   # phantom __all__ export
     ("LINT000", "noqabad.py", 1),   # noqa without reason
+    ("LINT000", "resbad.py", 1),    # transfers[] without reason
     ("LINT001", "noqabad.py", 2),   # unknown rule id + empty rule list
 ])
 def test_rule_fires_on_fixture(rule, path_part, min_hits):
@@ -140,6 +147,8 @@ def test_host_only_code_not_flagged_by_jit_rules():
     ("JIT001", "jitbad.py"),        # def-line noqa covers the body
     ("OBS001", "obsbad.py"),        # audited_total suppression
     ("PERF001", "perfbad.py"),      # suppressed_builder's audited noqa
+    ("RES001", "resbad.py"),        # suppressed_leak's audited noqa
+    ("DON001", "donbad.py"),        # suppressed_read's audited noqa
     ("DEAD001", "deadbad.py"),      # registry_hook getattr exemption
 ])
 def test_noqa_suppresses(rule, path_part):
@@ -147,6 +156,63 @@ def test_noqa_suppresses(rule, path_part):
     assert sup, f"expected a suppressed {rule} finding in {path_part}"
     for f in sup:
         assert f.reason and f.reason.strip(), f.render()
+
+
+def _fixture_line(fname: str, marker: str) -> int:
+    src = open(os.path.join(FIXTURES, "fixpkg", fname)).read()
+    return next(i for i, ln in enumerate(src.splitlines(), 1) if marker in ln)
+
+
+def test_pr6_leak_shape_caught_and_hardened_twin_clean():
+    """ISSUE 8 acceptance: disabling a PR-6 hardening fix (the
+    `finally: unpin`) makes RES001 fire — demonstrated on the fixture twin
+    pair, while the hardened shape stays clean."""
+    res1 = {f.line for f in _fired("RES001", "resbad.py")}
+    broken = _fixture_line("resbad.py", "RES001: PR-6 leak shape")
+    hardened = _fixture_line("resbad.py", "fine: finally releases")
+    assert broken in res1, "the unpin-removed twin must fire RES001"
+    assert hardened not in res1, "the try/finally twin must stay clean"
+
+
+def test_use_after_donate_shape_caught():
+    """ISSUE 8 acceptance: a read of the donated cache after dispatch is
+    caught (DON001), while the engines' rebind idioms stay clean."""
+    don1 = {f.line for f in _fired("DON001", "donbad.py")}
+    assert _fixture_line("donbad.py", "DON001: use-after-donate") in don1
+    res_all = [f for f in _fix_findings()
+               if f.rule.startswith("DON") and "donbad.py" in f.path
+               and not f.suppressed]
+    clean_lines = {_fixture_line("donbad.py", m) for m in
+                   ("fine: rebound", "fine: donate-and-rebind")}
+    assert not {f.line for f in res_all} & clean_lines
+
+
+def test_res_clean_shapes_not_flagged():
+    """The sanctioned idioms — with-block, conditional acquire +
+    try/finally, self-store handoff, tuple-return handoff, None-guard,
+    transfers annotation — must produce no RES findings."""
+    res = [f for f in _fix_findings()
+           if f.rule.startswith("RES") and "resbad.py" in f.path
+           and not f.suppressed]
+    lines = {f.line for f in res}
+    for marker in ("fine: conditional acquire", "fine: with manages it",
+                   "fine: stored on self", "fine: returned in a tuple",
+                   "fine: None branch exits", "fine: with closes it",
+                   "fine: not released on EVERY path",
+                   "lfkt: transfers[lease]"):
+        ln = _fixture_line("resbad.py", marker)
+        span = set(range(ln - 2, ln + 3))   # the acquire sits near the marker
+        assert not lines & span, (marker, sorted(lines))
+
+
+def test_exc001_good_shapes_not_flagged():
+    exc = [f for f in _fix_findings() if f.rule == "EXC001"
+           and not f.suppressed]
+    lines = {f.line for f in exc}
+    for marker in ("fine: every swallowing path",
+                   "fine: the failure is not swallowed"):
+        ln = _fixture_line("excbad.py", marker)
+        assert not lines & set(range(ln - 6, ln + 2)), (marker, lines)
 
 
 def test_good_lock_paths_not_flagged():
@@ -259,6 +325,57 @@ def test_cli_exits_nonzero_on_fixtures_with_json():
     assert proc.returncode == 1
     findings = [json.loads(line) for line in proc.stdout.splitlines()]
     assert findings and all("rule" in f and "line" in f for f in findings)
+
+
+def test_lint_report_baseline_ratchet(tmp_path):
+    """--write-baseline snapshots the fixture findings; --baseline then
+    exits 0 with all of them grandfathered, and exits 1 once the baseline
+    is missing one (a 'new' finding for the ratchet)."""
+    import json
+
+    bl = str(tmp_path / "baseline.json")
+    fix_args = ["--package", os.path.join(FIXTURES, "fixpkg"),
+                "--root", FIXTURES]
+    wrote = subprocess.run(
+        [sys.executable, "tools/lint_report.py", "--write-baseline", bl,
+         *fix_args], cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+    doc = json.load(open(bl))
+    assert doc["schema"] == 1 and doc["findings"]
+    assert all("line" not in e for e in doc["findings"])   # line-agnostic
+
+    ok = subprocess.run(
+        [sys.executable, "tools/lint_report.py", "--baseline", bl,
+         *fix_args], cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "grandfathered" in ok.stdout and "ratchet OK" in ok.stdout
+
+    # drop one grandfathered entry -> that finding is now NEW -> exit 1
+    dropped = doc["findings"][0]
+    doc["findings"] = doc["findings"][1:]
+    json.dump(doc, open(bl, "w"))
+    bad = subprocess.run(
+        [sys.executable, "tools/lint_report.py", "--baseline", bl,
+         *fix_args], cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert bad.returncode == 1
+    assert "NEW findings" in bad.stdout
+    assert dropped["rule"] in bad.stdout
+
+
+def test_ci_gate_aggregates_lint_and_manifest():
+    """tools/ci_gate.py (POST_SUITE_CHECKLIST step 1): one entry point,
+    both repo gates, --json machine shape, exit 0 on a clean tree."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, "tools/ci_gate.py", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    names = {c["name"] for c in doc["checks"]}
+    assert names == {"lfkt-lint", "check-manifest"}
+    assert all(c["exit"] == 0 for c in doc["checks"])
 
 
 def test_cli_lists_every_rule():

@@ -1,0 +1,351 @@
+"""Unit suite for the lint CFG engine itself (lint/cfg.py) — ISSUE 8.
+
+The rule families (RES/DON/EXC) are fixture-tested in test_lint.py; this
+file pins the GRAPH: which paths exist.  Each test builds a CFG from a
+small source snippet and asserts reachability between labeled statements
+and the two exits — branch/loop/orelse shapes, try/finally routing
+(including a finally that re-raises), handler dispatch, `with` bodies
+that suppress, and the solver's may/must joins.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llama_fastapi_k8s_gpu_tpu.lint.cfg import (
+    build_cfg, can_raise, eval_roots, reachable, solve_forward,
+)
+
+
+def _cfg(src: str):
+    tree = ast.parse(src)
+    fn = tree.body[0]
+    assert isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return build_cfg(fn)
+
+
+def _node(cfg, marker: str, src: str):
+    """The CFG node for the statement on the line containing ``marker``."""
+    line = next(i for i, ln in enumerate(src.splitlines(), 1)
+                if marker in ln)
+    for n in cfg.stmt_nodes():
+        if n.stmt.lineno == line:
+            return n
+    raise AssertionError(f"no node on line {line} ({marker!r})")
+
+
+def _reaches(a, b) -> bool:
+    return b in reachable(a)
+
+
+# ---------------------------------------------------------------------------
+# branches
+# ---------------------------------------------------------------------------
+
+IF_SRC = """\
+def f(x):
+    if x:
+        a = 1       # then
+    else:
+        b = 2       # orelse
+    c = 3           # after
+"""
+
+
+def test_if_both_branches_join():
+    cfg = _cfg(IF_SRC)
+    then = _node(cfg, "# then", IF_SRC)
+    orelse = _node(cfg, "# orelse", IF_SRC)
+    after = _node(cfg, "# after", IF_SRC)
+    assert _reaches(then, after) and _reaches(orelse, after)
+    assert not _reaches(then, orelse)
+    assert _reaches(after, cfg.exit)
+
+
+def test_if_edges_are_labeled():
+    cfg = _cfg(IF_SRC)
+    test = _node(cfg, "if x", IF_SRC)
+    kinds = {k for _t, k in test.succ}
+    assert {"true", "false"} <= kinds
+
+
+# ---------------------------------------------------------------------------
+# loops: back edge, orelse, break vs orelse
+# ---------------------------------------------------------------------------
+
+LOOP_SRC = """\
+def f(xs):
+    for x in xs:
+        if x:
+            break       # breaks
+        body = 1        # body
+    else:
+        ran_else = 1    # orelse
+    after = 1           # after
+"""
+
+
+def test_loop_break_bypasses_orelse():
+    cfg = _cfg(LOOP_SRC)
+    brk = _node(cfg, "# breaks", LOOP_SRC)
+    orelse = _node(cfg, "# orelse", LOOP_SRC)
+    after = _node(cfg, "# after", LOOP_SRC)
+    assert _reaches(brk, after)
+    # break's own normal successors skip the orelse statement
+    assert orelse not in reachable(brk, kinds=("norm", "true", "false"))
+
+
+def test_loop_back_edge_exists():
+    cfg = _cfg(LOOP_SRC)
+    header = _node(cfg, "for x in xs", LOOP_SRC)
+    body = _node(cfg, "# body", LOOP_SRC)
+    assert _reaches(body, header)          # back edge
+    assert _reaches(header, _node(cfg, "# orelse", LOOP_SRC))
+
+
+WHILE_SRC = """\
+def f(n):
+    while n:
+        n = step(n)     # body
+    done = 1            # after
+"""
+
+
+def test_while_body_can_raise_to_exit():
+    cfg = _cfg(WHILE_SRC)
+    body = _node(cfg, "# body", WHILE_SRC)
+    assert cfg.raise_exit in reachable(body)
+    assert _reaches(body, _node(cfg, "# after", WHILE_SRC))
+
+
+# ---------------------------------------------------------------------------
+# try/except/else/finally
+# ---------------------------------------------------------------------------
+
+TRY_SRC = """\
+def f():
+    try:
+        risky()         # risky
+    except ValueError:
+        handled = 1     # handler
+    else:
+        ran_else = 1    # orelse
+    after = 1           # after
+"""
+
+
+def test_exception_reaches_handler_and_propagates_unmatched():
+    cfg = _cfg(TRY_SRC)
+    risky = _node(cfg, "# risky", TRY_SRC)
+    handler = _node(cfg, "# handler", TRY_SRC)
+    orelse = _node(cfg, "# orelse", TRY_SRC)
+    assert _reaches(risky, handler)
+    assert _reaches(risky, orelse)
+    # except ValueError is NOT a catch-all: unmatched exceptions propagate
+    assert cfg.raise_exit in reachable(risky)
+    # the handler body does not run on the no-exception path's orelse
+    assert orelse not in reachable(handler)
+
+
+def test_catch_all_handler_stops_propagation():
+    src = TRY_SRC.replace("except ValueError", "except Exception")
+    cfg = _cfg(src)
+    risky = _node(cfg, "# risky", src)
+    # risky's ONLY exceptional continuation is the handler (plus exits via
+    # later code); the dispatch node no longer leaks to raise_exit directly
+    dispatch = [t for t, k in risky.succ if k == "exc"][0]
+    assert all(k != "exc" for _t, k in dispatch.succ)
+
+
+FINALLY_SRC = """\
+def f():
+    try:
+        risky()         # risky
+        return 1        # early
+    finally:
+        cleanup()       # cleanup
+    unreachable = 1     # after
+"""
+
+
+def test_finally_runs_on_normal_return_and_exception():
+    cfg = _cfg(FINALLY_SRC)
+    risky = _node(cfg, "# risky", FINALLY_SRC)
+    early = _node(cfg, "# early", FINALLY_SRC)
+    # several finally copies exist (one per continuation); both the raise
+    # path and the return path must pass through SOME cleanup node
+    cleanups = [n for n in cfg.stmt_nodes()
+                if getattr(n.stmt, "lineno", 0) == _node(
+                    cfg, "# cleanup", FINALLY_SRC).stmt.lineno]
+    assert len(cleanups) >= 2              # duplicated per continuation
+    assert any(c in reachable(risky) for c in cleanups)
+    assert any(c in reachable(early) for c in cleanups)
+    # the return cannot skip cleanup: its only outgoing edge chain passes
+    # a cleanup node before cfg.exit
+    direct = {t for t, k in early.succ if k == "norm"}
+    assert all(any(_reaches(d, c) or d is c for c in cleanups)
+               for d in direct)
+
+
+RERAISE_SRC = """\
+def f():
+    try:
+        risky()         # risky
+    finally:
+        raise RuntimeError("poison")    # reraises
+    after = 1           # after
+"""
+
+
+def test_finally_that_reraises_kills_normal_exit():
+    cfg = _cfg(RERAISE_SRC)
+    risky = _node(cfg, "# risky", RERAISE_SRC)
+    after_line = next(i for i, ln in enumerate(RERAISE_SRC.splitlines(), 1)
+                      if "# after" in ln)
+    reached_lines = {getattr(n.stmt, "lineno", 0)
+                     for n in reachable(risky) if n.stmt is not None}
+    assert after_line not in reached_lines
+    assert cfg.raise_exit in reachable(risky)
+    # the normal exit is unreachable from inside the try
+    assert cfg.exit not in reachable(risky)
+
+
+def test_return_through_finally_reaches_exit():
+    cfg = _cfg(FINALLY_SRC)
+    early = _node(cfg, "# early", FINALLY_SRC)
+    assert cfg.exit in reachable(early)
+
+
+# ---------------------------------------------------------------------------
+# with — including exception-suppressing context managers
+# ---------------------------------------------------------------------------
+
+WITH_SRC = """\
+def f(lock):
+    with lock:
+        risky()         # risky
+    after = 1           # after
+"""
+
+
+def test_with_body_exception_propagates_by_default():
+    cfg = _cfg(WITH_SRC)
+    risky = _node(cfg, "# risky", WITH_SRC)
+    assert cfg.raise_exit in reachable(risky)
+    assert _reaches(risky, _node(cfg, "# after", WITH_SRC))
+
+
+SUPPRESS_SRC = """\
+def f():
+    import contextlib
+    with contextlib.suppress(ValueError):
+        risky()         # risky
+    after = 1           # after
+"""
+
+
+def test_with_suppress_lets_exception_resume_after_body():
+    cfg = _cfg(SUPPRESS_SRC)
+    risky = _node(cfg, "# risky", SUPPRESS_SRC)
+    after = _node(cfg, "# after", SUPPRESS_SRC)
+    # the exceptional edge out of the body can RESUME at `after`
+    exc_targets = [t for t, k in risky.succ if k == "exc"]
+    assert exc_targets and any(after in reachable(t) for t in exc_targets)
+
+
+# ---------------------------------------------------------------------------
+# raise model + eval roots
+# ---------------------------------------------------------------------------
+
+def test_can_raise_model():
+    mod = ast.parse(
+        "x = y\n"                   # plain alias: cannot raise
+        "z = f()\n"                 # call: can raise
+        "assert z\n"                # assert: can raise
+        "def g():\n    h()\n"       # def stmt: body does not execute
+    )
+    alias, call, assert_, fndef = mod.body
+    assert not can_raise(alias)
+    assert can_raise(call)
+    assert can_raise(assert_)
+    assert not can_raise(fndef)
+
+
+def test_eval_roots_exclude_compound_bodies():
+    mod = ast.parse("while cond:\n    body_call()\n")
+    loop = mod.body[0]
+    roots = eval_roots(loop)
+    names = {n.id for r in roots for n in ast.walk(r)
+             if isinstance(n, ast.Name)}
+    assert "cond" in names and "body_call" not in names
+
+
+# ---------------------------------------------------------------------------
+# the solver: may vs must joins
+# ---------------------------------------------------------------------------
+
+SOLVER_SRC = """\
+def f(x):
+    if x:
+        a = 1           # seta
+    b = 2               # after
+"""
+
+
+def _writes_flow(node, state):
+    stmt = node.stmt
+    if stmt is None:
+        return {"*": state}
+    names = set()
+    if isinstance(stmt, ast.Assign):
+        names = {t.id for t in stmt.targets if isinstance(t, ast.Name)}
+    return {"*": state | frozenset(names)}
+
+
+def test_solver_may_vs_must():
+    cfg = _cfg(SOLVER_SRC)
+    may = solve_forward(cfg, frozenset(), _writes_flow, lambda p, q: p | q)
+    must = solve_forward(cfg, frozenset(), _writes_flow, lambda p, q: p & q)
+    assert "a" in may[cfg.exit] and "b" in may[cfg.exit]
+    assert "a" not in must[cfg.exit] and "b" in must[cfg.exit]
+
+
+def test_solver_loop_terminates_and_accumulates():
+    src = """\
+def f(n):
+    while n:
+        a = 1           # seta
+    b = 2
+"""
+    cfg = _cfg(src)
+    may = solve_forward(cfg, frozenset(), _writes_flow, lambda p, q: p | q)
+    assert {"a", "b"} <= may[cfg.exit]
+
+
+def test_exits_unreachable_states_absent():
+    src = """\
+def f():
+    return 1
+"""
+    cfg = _cfg(src)
+    IN = solve_forward(cfg, frozenset(), _writes_flow, lambda p, q: p | q)
+    assert cfg.exit in IN
+    assert cfg.raise_exit not in IN     # nothing can raise here
+
+
+# ---------------------------------------------------------------------------
+# async bodies build too (the server's consumer/tasks are async)
+# ---------------------------------------------------------------------------
+
+ASYNC_SRC = """\
+async def f(q):
+    await q.acquire()   # acq
+    spawn()             # spawn
+"""
+
+
+def test_async_function_builds():
+    cfg = _cfg(ASYNC_SRC)
+    acq = _node(cfg, "# acq", ASYNC_SRC)
+    assert cfg.exit in reachable(acq)
+    assert cfg.raise_exit in reachable(acq)

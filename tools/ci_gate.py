@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Single CI gate: lfkt-lint + the evidence-ledger check, one exit code.
+
+POST_SUITE_CHECKLIST step 1 used to be two manual commands (the lint
+module and tools/check_manifest.py); this entry point runs both, streams
+their output, and aggregates exit codes — nonzero if ANY check fails, so
+one command gates a commit:
+
+  python tools/ci_gate.py            # human output, exit != 0 on failure
+  python tools/ci_gate.py --json     # {"ok": bool, "checks": [...]}
+
+Each check runs in a subprocess (the same commands a human would run, so
+this wrapper can never drift from what it claims to gate) with a bounded
+timeout.  Add future repo-wide gates here rather than growing the
+checklist.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: (name, argv) — every gate a commit must pass, in order
+CHECKS: list[tuple[str, list[str]]] = [
+    ("lfkt-lint", [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.lint"]),
+    ("check-manifest", [sys.executable,
+                        os.path.join(ROOT, "tools", "check_manifest.py")]),
+]
+
+
+def run_checks(timeout: float = 300.0) -> list[dict]:
+    results = []
+    for name, argv in CHECKS:
+        try:
+            proc = subprocess.run(argv, cwd=ROOT, capture_output=True,
+                                  text=True, timeout=timeout)
+            results.append({
+                "name": name,
+                "exit": proc.returncode,
+                "ok": proc.returncode == 0,
+                "output": (proc.stdout + proc.stderr).strip(),
+            })
+        except subprocess.TimeoutExpired:
+            results.append({"name": name, "exit": -1, "ok": False,
+                            "output": f"timed out after {timeout:.0f}s"})
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable aggregate result")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="per-check timeout in seconds")
+    args = ap.parse_args()
+
+    results = run_checks(timeout=args.timeout)
+    ok = all(r["ok"] for r in results)
+    if args.json:
+        print(json.dumps({"ok": ok, "checks": results}, indent=1))
+    else:
+        for r in results:
+            mark = "OK  " if r["ok"] else "FAIL"
+            print(f"[{mark}] {r['name']} (exit {r['exit']})")
+            if not r["ok"] and r["output"]:
+                print("  " + r["output"].replace("\n", "\n  "))
+        print(f"ci_gate: {'OK' if ok else 'FAIL'} "
+              f"({sum(r['ok'] for r in results)}/{len(results)} checks)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
