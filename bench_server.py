@@ -72,6 +72,11 @@ def main() -> None:
     spec_draft = int(os.environ.get("LFKT_SPEC_DRAFT", "8"))
     fullctx = os.environ.get("LFKT_BENCH_FULLCTX") == "1"
     multiturn = os.environ.get("LFKT_BENCH_MULTITURN") == "1"
+    # mixed-model arm (docs/MULTIMODEL.md): serve TWO models from one
+    # process through the continuous scheduler and alternate model=
+    # across lanes via /v1/chat/completions — per-model agg tok/s says
+    # what co-residency costs vs a single-model pod
+    mixed_models = os.environ.get("LFKT_BENCH_MIXED_MODELS") == "1"
     from llama_fastapi_k8s_gpu_tpu.utils.config import env_bool
 
     lane_prefix = env_bool("LFKT_LANE_PREFIX_CACHE")
@@ -113,12 +118,17 @@ def main() -> None:
     if kv_dtype != "bf16":
         wfmt = f"{wfmt},kv-{kv_dtype}"
     batch = int(os.environ.get("LFKT_BENCH_BATCH", "1"))
+    if mixed_models and batch <= 1:
+        raise SystemExit(
+            "LFKT_BENCH_MIXED_MODELS=1 needs LFKT_BENCH_BATCH>1: the arm "
+            "measures models interleaving across scheduler lanes")
     # the app sizes its in-flight permit pool from settings.batch_size
     # (server/app.py: Semaphore(max(1, settings.batch_size))) — without
     # this the server serializes requests at inflight=1 and a B-lane
     # engine decodes one lane at a time (measured: batch=4 aggregate
-    # throughput equal to a single lane's)
-    os.environ["LFKT_BATCH_SIZE"] = str(batch)
+    # throughput equal to a single lane's).  The mixed arm serves TWO
+    # B-lane engines, so its permit pool must cover both fleets.
+    os.environ["LFKT_BATCH_SIZE"] = str(2 * batch if mixed_models else batch)
     from llama_fastapi_k8s_gpu_tpu.utils.config import Settings, get_settings
 
     settings = get_settings()
@@ -159,6 +169,27 @@ def main() -> None:
         # ',laneprefix'-labeled artifact with reuse actually off would be a
         # mislabeled A/B arm in the evidence ledger
         lane_prefix = bool(getattr(eng, "_lane_prefix", False))
+        if mixed_models:
+            # second co-resident model: SAME synthetic weights (identity
+            # matters to the scheduler, not the bytes — sharing the
+            # params pytree keeps the HBM cost honest to a real
+            # two-model pod only in the KV/lane dimension, which is what
+            # this arm measures: interleaved multi-model scheduling)
+            from llama_fastapi_k8s_gpu_tpu.serving import ModelRegistry
+
+            eng_b = ContinuousEngine.from_parts(
+                params, cfg, tok, template_kind="llama3",
+                max_gen_tokens=max_tokens, attn_impl=cfg.attn_impl,
+                dp=1, batch_size=batch,
+                decode_chunk=settings.decode_chunk,
+                adm_budget=settings.adm_budget,
+                adm_controller=settings.adm_controller,
+                adm_ema_alpha=settings.adm_ema_alpha,
+                prefill_overlap=settings.prefill_overlap,
+                spec_decode=spec_decode, spec_draft=spec_draft,
+                lane_prefix_cache=lane_prefix,
+                prefill_chunk=settings.prefill_chunk)
+            eng = ModelRegistry({"alpha": eng, "beta": eng_b}, "alpha")
     else:
         # prefix reuse stays OFF for the standard phases: they re-POST a
         # byte-identical payload n_req times, so the serial engine's
@@ -309,6 +340,90 @@ def main() -> None:
         if first is None:
             first = (time.perf_counter() - t0) * 1e3
         return first, "".join(parts), err
+
+    if mixed_models:
+        # LFKT_BENCH_MIXED_MODELS=1 + LFKT_BENCH_BATCH=B: `conc` worker
+        # threads split across the two models, each POSTing
+        # /v1/chat/completions with its model= — lanes of both models
+        # decode concurrently and the schedulers interleave their waves
+        # on the one device queue.  Per-model aggregate tok/s comes from
+        # the responses' usage counts (the facade returns them; /response
+        # strips usage off the wire).
+        conc = int(os.environ.get("LFKT_BENCH_CONCURRENCY", str(2 * batch)))
+        per = max(2, n_req // 2)
+        model_names = ("alpha", "beta")
+        agg = {name: {"tokens": 0, "completed": 0, "lat_ms": [],
+                      "errors": 0} for name in model_names}
+        lk = threading.Lock()
+
+        def mixed_worker(i: int):
+            name = model_names[i % 2]        # alternating model= per lane
+            body = json.dumps({
+                "model": name,
+                "max_tokens": max_tokens,
+                "temperature": 0.7,
+                "messages": [{"role": "user",
+                              "content": "Tell me about the weather "
+                                         f"today, worker {i}."}],
+            }).encode()
+            req = urllib.request.Request(
+                base + "/v1/chat/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            for _ in range(per):
+                t0 = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(req, timeout=600) as r:
+                        doc = json.loads(r.read())
+                    ms = (time.perf_counter() - t0) * 1e3
+                    with lk:
+                        agg[name]["tokens"] += doc["usage"]["completion_tokens"]
+                        agg[name]["completed"] += 1
+                        agg[name]["lat_ms"].append(ms)
+                except Exception:  # noqa: BLE001 — count, keep sampling
+                    with lk:
+                        agg[name]["errors"] += 1
+
+        t_mx = time.perf_counter()
+        ths = [threading.Thread(target=mixed_worker, args=(i,))
+               for i in range(conc)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        mx_s = time.perf_counter() - t_mx
+        pq = lambda v, q: v[min(len(v) - 1, int(q * len(v)))]  # noqa: E731
+        per_model = {}
+        for name, a in agg.items():
+            a["lat_ms"].sort()
+            per_model[name] = {
+                "agg_tok_s": (round(a["tokens"] / mx_s, 1)
+                              if mx_s > 0 else None),
+                "gen_tokens": a["tokens"],
+                "completed": a["completed"],
+                "errors": a["errors"],
+                "latency_ms_p50": (round(pq(a["lat_ms"], 0.5), 1)
+                                   if a["lat_ms"] else None),
+            }
+        total_tokens = sum(a["tokens"] for a in agg.values())
+        result = {
+            "metric": (f"server_mixed_models_agg_tok_s[/v1,{preset},{wfmt}"
+                       f",models2,batch{batch}]"),
+            "value": round(total_tokens / mx_s, 1) if mx_s > 0 else 0.0,
+            "unit": "tok/s",
+            "per_model": per_model,
+            "models": list(model_names),
+            "threads": conc,
+            "requests_per_thread": per,
+            "max_tokens": max_tokens,
+            "decode_chunk": settings.decode_chunk,
+            "batch_size": batch,
+            "warmup_s": round(warm_s, 1),
+            "wall_s": round(mx_s, 1),
+            "scheduler_stats": eng.scheduler_stats(),
+            "device": str(dev),
+        }
+        emit_result(result)
+        os._exit(0)  # daemon server thread: skip graceful asyncio teardown
 
     if multiturn and batch > 1:
         # LFKT_BENCH_MULTITURN=1 + LFKT_BENCH_BATCH=C: C concurrent growing

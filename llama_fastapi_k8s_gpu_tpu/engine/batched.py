@@ -339,6 +339,8 @@ class MeshEngine(Engine):
             "prompt_tokens": int(sum(len(i) for i in ids_list[:n_real])),
             # shared cycle: every lane prefilled in one bucket program
             "bucket": bucket,
+            # model label for the per-model metric series (multi-model)
+            "model": self.model_name,
             "completion_tokens": total_new,
             "tokens_per_sec": (total_new - n_real) / decode_s
             if decode_s > 0 and total_new > n_real else 0.0,

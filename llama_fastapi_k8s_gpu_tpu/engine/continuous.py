@@ -648,7 +648,7 @@ class ContinuousEngine(MeshEngine):
                 # multi-turn follow-up stores only its delta
                 self._kvpool.commit_lane(
                     (list(slot.ids) + slot.gens)[:keep],
-                    self._bstate["cache"], lane)
+                    self._bstate["cache"], lane, namespace=self._kv_ns)
             return
         if not self._lane_prefix:
             return
@@ -795,12 +795,12 @@ class ContinuousEngine(MeshEngine):
         cap and alignment contract as :meth:`_find_lane_reuse`, against
         the process-wide radix index instead of per-lane claims."""
         pool = self._kvpool
-        i = min(pool.match_len(ids), len(ids) - 1)
+        i = min(pool.match_len(ids, namespace=self._kv_ns), len(ids) - 1)
         r = (i // self._paged_align) * self._paged_align
         if r < self._paged_align:
             pool.note_miss()
             return 0, None
-        lease = pool.acquire(ids, r, span=pspan)
+        lease = pool.acquire(ids, r, span=pspan, namespace=self._kv_ns)
         if lease is None:      # raced an eviction / spill-restore failed
             return 0, None
         if pspan is not None:
@@ -1027,6 +1027,8 @@ class ContinuousEngine(MeshEngine):
             "prefix_reused_tokens": slot.reused,
             # prompt bucket for the per-bucket TTFT series (obs/slo.py)
             "bucket": self._bucket_for(slot.n_prompt),
+            # model label for the per-model metric series (multi-model)
+            "model": self.model_name,
             "tokens_per_sec": (n - 1) / decode_s
             if n > 1 and decode_s > 0 else 0.0,
         }

@@ -172,6 +172,13 @@ class Engine:
         kv_pool_pages: int = 0,     # pool size in pages (0 = auto)
         kv_spill_pages: int = 0,    # host-RAM spill tier capacity (0 = off)
         *,
+        kv_pool=None,               # adopt a shared KVPool (multi-model
+        #                             registry, docs/MULTIMODEL.md) instead
+        #                             of building a private one
+        kv_namespace: str | None = None,  # this engine's prefix-cache
+        #                             namespace in the (shared) pool —
+        #                             prefixes NEVER match across
+        #                             namespaces (tenant isolation)
         _parts: tuple | None = None,  # (params, cfg, tokenizer, template_kind)
     ):
         FAULTS.fire("load")   # injection point: weight-load / re-init failure
@@ -388,14 +395,39 @@ class Engine:
         #: exceptions — is machine-checked by lfkt-lint RES001
         #: (docs/LINT.md), the PR-6 leak class made static.
         self._paged_lease = None
+        #: prefix-cache namespace: every pool index operation is keyed by
+        #: it, so co-resident models sharing one arena can never match
+        #: each other's token prefixes (the registry passes the manifest
+        #: model name; single-model engines use the default namespace)
+        self._kv_ns = kv_namespace or ""
         if paged:
-            from ..parallel.kvpool import KVPool
-
             self._prefix_cache = False
-            self._kvpool = KVPool(
-                self.cfg, page_tokens=kv_page_tokens,
-                n_pages=kv_pool_pages, spill_pages=kv_spill_pages,
-                sink_host=self)
+            if kv_pool is not None \
+                    and not kv_pool.compatible(self.cfg, kv_page_tokens):
+                # shared multi-model pool: N models partition one HBM page
+                # budget dynamically instead of each provisioning
+                # worst-case — but only an identical per-page cache
+                # geometry can share the arena.  Gate off with attribution
+                # (the SPEngine-paging idiom): this model serves from a
+                # private pool instead of failing the whole fleet.
+                logger.warning(
+                    "model %r (n_layers=%d, n_kv_heads=%d, head_dim=%d, "
+                    "kv_dtype=%s) cannot share the KV page arena: cache "
+                    "page geometry differs from the pool's — serving it "
+                    "from a private pool (docs/MULTIMODEL.md)",
+                    self.model_name, self.cfg.n_layers,
+                    self.cfg.n_kv_heads, self.cfg.head_dim,
+                    self.cfg.kv_dtype)
+                kv_pool = None
+            if kv_pool is not None:
+                self._kvpool = kv_pool
+            else:
+                from ..parallel.kvpool import KVPool
+
+                self._kvpool = KVPool(
+                    self.cfg, page_tokens=kv_page_tokens,
+                    n_pages=kv_pool_pages, spill_pages=kv_spill_pages,
+                    sink_host=self)
         else:
             self._kvpool = None
 
@@ -418,6 +450,16 @@ class Engine:
         if pool is not None:
             total += pool.arena_nbytes
         return total
+
+    @property
+    def weight_bytes(self) -> int:
+        """Resident HBM bytes of this model's weights (shape metadata,
+        summed over the params pytree) — the multi-model registry's HBM
+        weight-budget unit and the ``model_weight_bytes`` gauge."""
+        params = getattr(self, "params", None)
+        if params is None:
+            return 0
+        return sum(leaf.nbytes for leaf in jax.tree.leaves(params))
 
     def kv_pool_occupancy(self) -> dict | None:
         """Paged-pool occupancy + event counters — the /health ``kv_pool``
@@ -872,7 +914,7 @@ class Engine:
         self._drop_lease()   # a prior request's exception may have leaked
         pool = self._kvpool
         T = pool.page_tokens
-        i = min(pool.match_len(ids), n_prompt - 1)
+        i = min(pool.match_len(ids, namespace=self._kv_ns), n_prompt - 1)
         r_fit = 0
         # same clamp as _prefix_reuse_len: the padded suffix slice
         # [reuse, reuse + sbucket) must stay inside the ring, and the
@@ -888,7 +930,7 @@ class Engine:
         if r_fit == 0:
             pool.note_miss()
             return 0
-        lease = pool.acquire(ids, r_fit, span=pspan)
+        lease = pool.acquire(ids, r_fit, span=pspan, namespace=self._kv_ns)
         if lease is None:    # raced an eviction / spill-restore failed
             return 0
         self._paged_lease = lease
@@ -922,7 +964,8 @@ class Engine:
             # the last sampled one.
             keep = ctx["n_prompt"] + max(n - 1, 0)
             self._kvpool.commit((ctx["prompt_ids"] + ctx["ids"])[:keep],
-                                self._cache, span=ctx.get("span"))
+                                self._cache, span=ctx.get("span"),
+                                namespace=self._kv_ns)
             self._drop_lease()
         elif self._prefix_cache:
             # ring slots [0, n_prompt + n - 1) now hold prompt + all
@@ -942,6 +985,9 @@ class Engine:
             "prefix_reused_tokens": ctx.get("reused", 0),
             # prompt bucket for the per-bucket TTFT series (obs/slo.py)
             "bucket": ctx.get("bucket", 0),
+            # model label for the per-model metric series (multi-model
+            # serving, docs/MULTIMODEL.md)
+            "model": self.model_name,
             # first token came out of prefill; the decode phase produced n-1
             "tokens_per_sec": (n - 1) / decode_s if n > 1 and decode_s > 0 else 0.0,
         }

@@ -76,8 +76,8 @@ METRICS: dict[str, Metric] = _register(
            "admission-queue wait (enqueue -> consumer pickup)",
            buckets=LATENCY_BUCKETS),
     Metric("generation_seconds", HISTOGRAM,
-           "engine generation wall time (prefill + decode)",
-           buckets=LATENCY_BUCKETS),
+           "engine generation wall time (prefill + decode), by model",
+           buckets=LATENCY_BUCKETS, labels=("model",)),
     Metric("queue_depth", GAUGE, "admission queue occupancy"),
     Metric("requests_rejected_total", COUNTER,
            "503s from the bounded admission queue"),
@@ -86,12 +86,13 @@ METRICS: dict[str, Metric] = _register(
     # -- engine phase timings (SURVEY §5 per-phase timers) -----------------
     Metric("engine_ttft_seconds", HISTOGRAM,
            "time to first token (prefill + first sample), by prefill "
-           "bucket — the SLO engine evaluates each bucket series "
-           "separately (docs/SLO.md)",
-           buckets=LATENCY_BUCKETS, labels=("bucket",)),
+           "bucket and model — the SLO engine evaluates each label "
+           "series separately, so burn rates report the worst "
+           "bucket+model (docs/SLO.md)",
+           buckets=LATENCY_BUCKETS, labels=("bucket", "model")),
     Metric("engine_decode_tokens_per_sec", HISTOGRAM,
-           "per-request decode throughput",
-           buckets=RATE_BUCKETS),
+           "per-request decode throughput, by model",
+           buckets=RATE_BUCKETS, labels=("model",)),
     Metric("generated_tokens_total", COUNTER, "completion tokens emitted"),
     Metric("batched_generations_total", COUNTER,
            "mesh-batched generation cycles"),
@@ -153,6 +154,13 @@ METRICS: dict[str, Metric] = _register(
     Metric("engine_error_count", GAUGE, "heartbeat errors_total"),
     # -- capacity ----------------------------------------------------------
     Metric("kv_cache_bytes", GAUGE, "resident KV-cache HBM bytes"),
+    # -- multi-model serving (serving/registry.py; docs/MULTIMODEL.md) -----
+    Metric("models_loaded", GAUGE,
+           "models served by this process (manifest rows, or 1)"),
+    Metric("model_weight_bytes", GAUGE,
+           "resident weight HBM bytes per served model (the registry's "
+           "LFKT_HBM_WEIGHT_BUDGET_MB accounting unit)",
+           labels=("model",)),
     # -- tracer self-telemetry (obs/trace.py) ------------------------------
     Metric("trace_ring_used", GAUGE, "completed traces held in the ring"),
     # monotonic tracer counters exported as point-in-time snapshots (the

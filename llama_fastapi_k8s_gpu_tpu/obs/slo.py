@@ -87,10 +87,12 @@ SLOS: tuple[SLO, ...] = (
     SLO("ttft_p95", metric="engine_ttft_seconds", kind=LATENCY,
         threshold_knob="LFKT_SLO_TTFT_P95_S", objective=0.95,
         help="95% of requests see their first token within the bound, "
-             "evaluated per prefill bucket (worst bucket reported)"),
+             "evaluated per prefill bucket and model (worst "
+             "bucket+model series reported)"),
     SLO("decode_floor", metric="engine_decode_tokens_per_sec", kind=FLOOR,
         threshold_knob="LFKT_SLO_DECODE_FLOOR_TPS", objective=0.95,
-        help="95% of requests decode at or above the floor"),
+        help="95% of requests decode at or above the floor, per model "
+             "(worst model reported)"),
     SLO("error_rate", metric="http_requests_total", kind=RATIO,
         threshold_knob="LFKT_SLO_ERROR_RATE",
         bad_label="code", bad_prefix="5",

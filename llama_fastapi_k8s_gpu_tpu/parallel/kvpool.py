@@ -37,6 +37,17 @@ generation mutex, the continuous scheduler from its own thread.  Pages
 referenced by an in-flight request are pinned (per-page refcounts) and
 can never be evicted; eviction is LRU over unpinned leaf nodes.
 
+Namespaces (multi-model serving, docs/MULTIMODEL.md): every public
+index operation takes a ``namespace`` key — one radix root per
+namespace, so co-resident models sharing the arena can NEVER match each
+other's prefixes (two models produce different KV for the same token
+ids, and tenant A's system prompt must not leak into tenant B's cache).
+The page arena, free list and LRU clock stay shared: N models partition
+the same HBM page budget dynamically instead of each provisioning
+worst-case, and eviction pressure from a hot model reclaims a cold
+model's pages.  ``compatible()`` says whether another model's cache
+geometry can share this arena at all (same leaf shapes/dtypes per page).
+
 Compiled-shape bound: page moves dispatch in groups of at most
 ``_GROUP`` pages with traced offsets/ids, so the whole pool compiles at
 most ``2 * _GROUP`` small copy programs per cache layout — page ops are
@@ -213,7 +224,7 @@ class KVPool:
         "arena": "_lock",
         "_free": "_lock",
         "_page_refs": "_lock",
-        "_root": "_lock",
+        "_roots": "_lock",
         "_clock": "_lock",
         "_spill_used": "_lock",
         "_busy": "_lock",
@@ -249,10 +260,17 @@ class KVPool:
         self.page_nbytes = sum(
             int(np.prod(s.shape[:2] + (T,) + s.shape[3:]))
             * jnp.dtype(s.dtype).itemsize for s in jax.tree.leaves(spec))
+        #: per-page-leaf geometry fingerprint: what another ModelConfig
+        #: must reproduce to share this arena (see :meth:`compatible`)
+        self._page_spec = tuple(
+            (s.shape[:2] + (T,) + s.shape[3:], str(jnp.dtype(s.dtype)))
+            for s in jax.tree.leaves(spec))
         self._lock = threading.Lock()
         self._free: list[int] = list(range(self.n_pages))
         self._page_refs: dict[int, int] = {}
-        self._root = _Node([], [], None)
+        #: one radix root per namespace (model) — prefixes never match
+        #: across namespaces; the arena/free-list/LRU stay shared
+        self._roots: dict[str, _Node] = {}
         self._clock = 0
         self._spill_used = 0
         #: node ids an in-progress walk depends on — evict/age must skip
@@ -264,6 +282,17 @@ class KVPool:
             "stored_pages": 0, "evictions": 0, "spills": 0, "restores": 0,
             "store_skips": 0,
         }
+
+    @property
+    def _root(self) -> _Node:
+        """Default-namespace radix root (white-box tests and single-model
+        introspection; multi-model callers go through ``namespace=``).
+        Lock-free — callers may already hold ``_lock`` (the white-box
+        tests do); the dict setdefault is GIL-atomic."""
+        root = self._roots.get("")
+        if root is None:
+            root = self._roots.setdefault("", _Node([], [], None))  # lfkt: noqa[LOCK001] -- GIL-atomic setdefault (a losing racer's node is discarded); taking _lock here would deadlock the white-box callers that already hold it
+        return root
 
     # -- telemetry (never fails serving) -----------------------------------
     def _metrics(self):
@@ -285,14 +314,31 @@ class KVPool:
         """HBM bytes of the page arena (shape metadata; donation-safe)."""
         return sum(leaf.nbytes for leaf in jax.tree.leaves(self.arena))
 
+    def compatible(self, cfg: ModelConfig, page_tokens: int | None = None
+                   ) -> bool:
+        """Whether a model with ``cfg``'s cache geometry can share this
+        arena: same page size and the same per-leaf page shapes/dtypes
+        (layers, kv heads, head dim, kv dtype layout).  Models that differ
+        get their own pool — the registry attributes that at load time
+        (docs/MULTIMODEL.md)."""
+        if page_tokens is not None and int(page_tokens) != self.page_tokens:
+            return False
+        T = self.page_tokens
+        spec = jax.eval_shape(lambda: init_cache(cfg))
+        theirs = tuple(
+            (s.shape[:2] + (T,) + s.shape[3:], str(jnp.dtype(s.dtype)))
+            for s in jax.tree.leaves(spec))
+        return theirs == self._page_spec
+
     # ------------------------------------------------------------------
     # public surface (each entry point takes the lock once)
     # ------------------------------------------------------------------
-    def match_len(self, ids) -> int:
+    def match_len(self, ids, *, namespace: str = "") -> int:
         """Tokens of ``ids`` covered by cached whole pages (device OR
-        spilled) — a pure peek: no pin, no counters, no restore."""
+        spilled) in ``namespace`` — a pure peek: no pin, no counters, no
+        restore."""
         with self._lock:
-            return self._match(list(ids))[0] * self.page_tokens
+            return self._match(list(ids), namespace)[0] * self.page_tokens
 
     def note_miss(self) -> None:
         """Count one prefix-cache miss (the engine consulted the index and
@@ -301,7 +347,8 @@ class KVPool:
             self.counters["misses"] += 1
         self._emit("inc", "prefix_cache_misses_total")
 
-    def acquire(self, ids, tokens: int, span=None) -> _Lease | None:
+    def acquire(self, ids, tokens: int, span=None, *,
+                namespace: str = "") -> _Lease | None:
         """Pin the pages covering ``ids[:tokens]`` (``tokens`` a multiple
         of the page size, at most :meth:`match_len`).  Spilled pages on the
         path are restored into freshly allocated arena slots first; if that
@@ -314,7 +361,7 @@ class KVPool:
         if want < 1:
             return None
         with self._lock:
-            matched, path = self._match(list(ids))
+            matched, path = self._match(list(ids), namespace)
             ok = matched >= want
             page_ids: list[int] = []
             if ok:
@@ -388,7 +435,8 @@ class KVPool:
                        tokens=lease.tokens, host_s=round(time.time() - t0, 6))
         return ring
 
-    def commit(self, ids, ring: dict, span=None) -> int:
+    def commit(self, ids, ring: dict, span=None, *,
+               namespace: str = "") -> int:
         """Index the whole-page prefix of ``ids`` whose KV sits in ring
         slots [0, len(ids)): pages already cached are deduplicated (LRU
         touch only), the new tail is copied into freshly allocated arena
@@ -399,21 +447,24 @@ class KVPool:
         shared system prompt lives — and skips entirely only when not
         even one page can be had; serving never blocks on the cache.
         Returns the number of NEW pages stored."""
-        return self._commit_impl(list(ids), ring=ring, span=span)
+        return self._commit_impl(list(ids), ring=ring, span=span,
+                                 namespace=namespace)
 
-    def commit_lane(self, ids, bcache: dict, lane: int, span=None) -> int:
+    def commit_lane(self, ids, bcache: dict, lane: int, span=None, *,
+                    namespace: str = "") -> int:
         """As :meth:`commit`, reading lane ``lane`` of a batched cache —
         the continuous scheduler's freed-lane path."""
         return self._commit_impl(list(ids), bcache=bcache, lane=lane,
-                                 span=span)
+                                 span=span, namespace=namespace)
 
     def reset(self) -> None:
-        """Drop the index and free every page (watchdog recovery: lane
-        contents are of unknown validity, so nothing resident is
-        trustworthy).  Arena contents need no zeroing — unindexed pages
-        are unreachable."""
+        """Drop the index (EVERY namespace) and free every page (watchdog
+        recovery: lane contents are of unknown validity, so nothing
+        resident is trustworthy — with a shared multi-model pool, one
+        engine's trip resets all tenants' cache, conservatively).  Arena
+        contents need no zeroing — unindexed pages are unreachable."""
         with self._lock:
-            self._root = _Node([], [], None)
+            self._roots = {}
             self._free = list(range(self.n_pages))
             self._page_refs = {}
             self._spill_used = 0
@@ -426,6 +477,7 @@ class KVPool:
             free = len(self._free)
             pinned = len(self._page_refs)
             spill = self._spill_used
+            namespaces = len(self._roots)
         return {
             "page_tokens": self.page_tokens,
             "page_bytes": self.page_nbytes,
@@ -436,6 +488,7 @@ class KVPool:
             "spill_pages_total": self.spill_pages,
             "spill_pages_used": spill,
             "arena_bytes": self.arena_nbytes,
+            "namespaces": namespaces,
         }
 
     def stats(self) -> dict:
@@ -450,11 +503,20 @@ class KVPool:
         n = len(ids) // T
         return [tuple(ids[i * T:(i + 1) * T]) for i in range(n)]
 
-    def _match(self, ids: list):  # lfkt: holds[_lock]
-        """Greedy page-wise walk.  Returns (matched_pages, path) where
-        path is [(node, pages_matched_in_node), ...] root-first."""
+    def _root_for(self, ns: str) -> _Node:  # lfkt: holds[_lock]
+        root = self._roots.get(ns)
+        if root is None:
+            root = self._roots[ns] = _Node([], [], None)
+        return root
+
+    def _match(self, ids: list, ns: str = ""):  # lfkt: holds[_lock]
+        """Greedy page-wise walk of ``ns``'s tree.  Returns
+        (matched_pages, path) where path is
+        [(node, pages_matched_in_node), ...] root-first."""
         want = self._pages_of(ids)
-        node = self._root
+        node = self._roots.get(ns)
+        if node is None:
+            return 0, []
         i = 0
         path: list[tuple[_Node, int]] = []
         while i < len(want):
@@ -513,13 +575,13 @@ class KVPool:
         return True
 
     def _commit_impl(self, ids: list, ring=None, bcache=None, lane=None,
-                     span=None) -> int:
+                     span=None, namespace: str = "") -> int:
         with self._lock:
             want = self._pages_of(ids)
             if not want:
                 return 0
             self.counters["commits"] += 1
-            matched, path = self._match(ids)
+            matched, path = self._match(ids, namespace)
             self._clock += 1
             for node, _n in path:
                 node.stamp = self._clock
@@ -551,7 +613,7 @@ class KVPool:
             elif path:
                 parent = path[-1][0]
             else:
-                parent = self._root
+                parent = self._root_for(namespace)
             T = self.page_tokens
             off = 0
             try:
@@ -602,8 +664,12 @@ class KVPool:
         return upper
 
     def _nodes(self) -> list:  # lfkt: holds[_lock]
+        """Every tree node across ALL namespaces — eviction/spill/aging
+        are pool-wide (one LRU clock), so a hot model's pressure reclaims
+        a cold model's pages."""
         out = []
-        stack = list(self._root.children.values())
+        stack = [c for root in self._roots.values()
+                 for c in root.children.values()]
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())

@@ -56,12 +56,36 @@ from ..utils.health import (
     HealthMonitor,
 )
 from ..utils.metrics import Metrics
-from .schemas import BotMessageRequest
+from .schemas import BotMessageRequest, ChatCompletionRequest
 
 logging.basicConfig(level=logging.INFO)
 logger = logging.getLogger(__name__)
 
 _STREAM_DONE = object()  # consumer→handler sentinel: stream finished cleanly
+
+
+def _openai_error_body(status: int, message: str, code: str | None = None
+                       ) -> dict:
+    """The OpenAI-style error envelope served on every ``/v1/*`` failure
+    (docs/MULTIMODEL.md facade mapping): 4xx are the caller's fault
+    (``invalid_request_error``; 408 keeps its own type so SDK retry
+    policies can tell a timeout from a bad request), 5xx are ours."""
+    if status >= 500 or status == 503:
+        etype = "server_error"
+    elif status == 408:
+        etype = "timeout_error"
+    else:
+        etype = "invalid_request_error"
+    return {"error": {"message": message, "type": etype,
+                      "param": None, "code": code}}
+
+
+def _openai_http_error(e: HTTPException) -> JSONResponse:
+    msg = e.detail if isinstance(e.detail, str) else json.dumps(e.detail)
+    return JSONResponse(
+        _openai_error_body(e.status_code, msg,
+                           getattr(e, "openai_code", None)),
+        e.status_code)
 
 
 def _accepts_kwarg(fn, name: str) -> bool:
@@ -134,6 +158,7 @@ def create_app(engine=None, settings: Settings | None = None,
     app = MicroAPI(title="chat-ai (tpu)", version="0.1.0")
     app.state.settings = settings
     app.state.engine = engine
+    app.state.created = int(time.time())   # /v1/models "created" stamp
     app.state.metrics = Metrics()
     app.state.tracer = tracer if tracer is not None else TRACER
     #: SLO burn-rate engine over this app's metrics (obs/slo.py): /metrics
@@ -224,24 +249,32 @@ def create_app(engine=None, settings: Settings | None = None,
                     live.append(rd)
             results: list[tuple] = []           # (request, response, error)
             if can_batch and live:
+                # /v1 facade requests never coalesce into a mesh cycle:
+                # the batched path applies the /response truncation quirks
+                # and returns text, both wrong for the OpenAI contract —
+                # they take the per-request path below instead
+                batchable = [rd for rd in live if not rd.get("openai")]
+                solo = [rd for rd in live if rd.get("openai")]
+            else:
+                batchable, solo = [], live
+            if batchable:
                 # batch-of-one included: MeshEngine.warmup compiles only the
                 # batched shapes, so even solo requests must use them
                 try:
                     responses = await _truncate_and_generate_batch(
-                        live, semaphore)
+                        batchable, semaphore)
                     results = [
                         (rd, None, r) if isinstance(r, Exception) else (rd, r, None)
-                        for rd, r in zip(live, responses)
+                        for rd, r in zip(batchable, responses)
                     ]
                 except Exception as e:  # noqa: BLE001 — one program, one failure
-                    results = [(rd, None, e) for rd in live]
-            else:
-                for rd in live:     # per-request isolation (reference semantics)
-                    try:
-                        results.append((rd, await _truncate_and_generate(
-                            rd, semaphore), None))
-                    except Exception as e:  # noqa: BLE001
-                        results.append((rd, None, e))
+                    results = [(rd, None, e) for rd in batchable]
+            for rd in solo:         # per-request isolation (reference semantics)
+                try:
+                    results.append((rd, await _truncate_and_generate(
+                        rd, semaphore), None))
+                except Exception as e:  # noqa: BLE001
+                    results.append((rd, None, e))
             for rd, resp, err in results:
                 if rd["future"].cancelled():
                     logger.info("Future cancelled during processing; "
@@ -262,6 +295,17 @@ def create_app(engine=None, settings: Settings | None = None,
             for _ in batch:
                 queue.task_done()
 
+    def _model_label(obj=None) -> str:
+        """Bounded-cardinality ``model`` label value: the per-request model
+        from a response/timings dict when present, else the engine's (or
+        the registry's default) name — one series per served model."""
+        name = None
+        if isinstance(obj, dict):
+            name = obj.get("model")
+        if not name:
+            name = getattr(app.state.engine, "model_name", None)
+        return str(name or "")
+
     def _observe_engine_timings(m, answer=None):
         """Record per-phase engine timings: prefer the per-request values
         attached to the response (no shared-state read-back); fall back to
@@ -270,14 +314,17 @@ def create_app(engine=None, settings: Settings | None = None,
         if timings is None:
             timings = getattr(app.state.engine, "last_timings", None)
         if timings:
-            # per-prefill-bucket TTFT series: the SLO engine evaluates each
-            # bucket separately, so a 32k-prompt violation cannot hide
-            # under a flood of short prompts (docs/SLO.md)
+            # per-prefill-bucket TTFT series, labeled per model: the SLO
+            # engine evaluates each label series separately, so a
+            # 32k-prompt (or one misbehaving co-resident model's)
+            # violation cannot hide under the rest (docs/SLO.md —
+            # worst_series now names the worst bucket AND model)
+            model = _model_label(timings)
             m.observe("engine_ttft_seconds", timings["ttft_s"],
-                      bucket=str(timings.get("bucket", 0)))
+                      bucket=str(timings.get("bucket", 0)), model=model)
             if timings["tokens_per_sec"]:
                 m.observe("engine_decode_tokens_per_sec",
-                          timings["tokens_per_sec"])
+                          timings["tokens_per_sec"], model=model)
             spec = timings.get("spec")
             if spec:   # speculative decode: acceptance is THE payoff number
                 m.inc("spec_drafted_tokens_total", spec["drafted"])
@@ -303,6 +350,69 @@ def create_app(engine=None, settings: Settings | None = None,
         return "".join(c["message"]["content"]
                        for c in answer.get("choices", []) if "message" in c)
 
+    def _answer_openai(answer, m) -> dict:
+        """/v1 facade result: the engine's OpenAI-shaped completion dict
+        verbatim (usage counts come straight from the engine's timings),
+        minus the internal ``lfkt_timings`` rider."""
+        if not isinstance(answer, dict):
+            logger.error("Unexpected response type: %s. Response: %s",
+                         type(answer), answer)
+            raise HTTPException(status_code=500,
+                                detail="Unexpected response from model")
+        usage = answer.get("usage") or {}
+        if usage.get("completion_tokens"):
+            m.inc("generated_tokens_total", usage["completion_tokens"])
+        answer = dict(answer)
+        answer.pop("lfkt_timings", None)
+        return answer
+
+    def _finish_answer(rd, answer, m):
+        """Shape one engine answer for its caller: the /v1 facade gets the
+        OpenAI dict, the /response path its concatenated text."""
+        if rd.get("openai"):
+            return _answer_openai(answer, m)
+        return _answer_to_text(answer, m)
+
+    def _gen_kwargs(rd) -> dict:
+        """Sampling/budget kwargs for one request: the pod's serving
+        defaults (reference api.py:59-62), overridden by the request's own
+        OpenAI fields when the /v1 facade set them (rd["params"])."""
+        kw = dict(
+            temperature=settings.temperature,
+            top_p=settings.top_p,
+            frequency_penalty=settings.frequency_penalty,
+            presence_penalty=settings.presence_penalty,
+        )
+        kw.update(rd.get("params") or {})
+        return kw
+
+    def _validate_model(model: str | None) -> str | None:
+        """400 for a model alias this pod does not serve.  Routed through
+        the registry's manifest when one is loaded; a single-model process
+        serves only its own name (or no name at all)."""
+        if model is None:
+            return None
+        eng = app.state.engine
+        has = getattr(eng, "has_model", None)
+        if callable(has):
+            if not has(model):
+                known = ", ".join(eng.model_names())
+                e = HTTPException(
+                    status_code=400,
+                    detail=f"unknown model {model!r}; this pod serves: "
+                           f"{known}")
+                e.openai_code = "model_not_found"
+                raise e
+            return model
+        name = getattr(eng, "model_name", None)
+        if name is not None and model != name:
+            e = HTTPException(
+                status_code=400,
+                detail=f"unknown model {model!r}; this pod serves: {name}")
+            e.openai_code = "model_not_found"
+            raise e
+        return model
+
     def _resilience_kw(rd) -> dict:
         """Deadline/abort/trace propagation kwargs for engines that accept
         them: the request's admission deadline, a did-the-caller-give-up
@@ -322,24 +432,43 @@ def create_app(engine=None, settings: Settings | None = None,
         m = app.state.metrics
         async with semaphore:  # one generation at a time (reference api.py:50)
             try:
-                messages = truncate_messages_to_fit_context(
-                    rd["messages"], settings.max_context_tokens)
+                # /v1 requests ride "raw": OpenAI clients manage their own
+                # history, so the reference's 400-char clip + index-2
+                # eviction must not rewrite their messages
+                if rd.get("raw"):
+                    messages = rd["messages"]
+                else:
+                    messages = truncate_messages_to_fit_context(
+                        rd["messages"], settings.max_context_tokens)
+                ckw = _gen_kwargs(rd)
+                if app.state.engine_kw.get("model"):
+                    ckw["model"] = rd.get("model")
                 t0 = time.time()
                 answer = await asyncio.to_thread(
                     lambda: app.state.engine.create_chat_completion(
                         messages=messages,
                         stream=False,
-                        temperature=settings.temperature,
-                        top_p=settings.top_p,
-                        frequency_penalty=settings.frequency_penalty,
-                        presence_penalty=settings.presence_penalty,
+                        **ckw,
                         **_resilience_kw(rd),
                     ))
-                m.observe("generation_seconds", time.time() - t0)
+                m.observe("generation_seconds", time.time() - t0,
+                          model=_model_label(answer))
                 _observe_engine_timings(m, answer)
-                return _answer_to_text(answer, m)
+                return _finish_answer(rd, answer, m)
             except HTTPException:
                 raise
+            except ValueError as e:
+                if rd.get("openai"):
+                    # client input error (oversized prompt, bad params):
+                    # the facade's structured 400, not a 500
+                    raise HTTPException(status_code=400,
+                                        detail=str(e)) from e
+                m.inc("engine_errors_total")
+                logger.error("Error during message generation: %s", e)
+                raise HTTPException(
+                    status_code=500,
+                    detail=f"Error during message generation: {str(e)}",
+                ) from e
             except EngineUnavailable as e:
                 # watchdog trip / recovery in progress: retryable 503, not
                 # the "this request hit a bug" 500
@@ -390,7 +519,10 @@ def create_app(engine=None, settings: Settings | None = None,
                         presence_penalty=settings.presence_penalty,
                         **batch_kw,
                     ))
-                m.observe("generation_seconds", time.time() - t0)
+                m.observe("generation_seconds", time.time() - t0,
+                          model=_model_label(next(
+                              (a for a in answers if isinstance(a, dict)),
+                              None)))
                 m.inc("batched_generations_total")
                 m.observe("batch_occupancy", len(batch_messages))
                 _observe_engine_timings(
@@ -446,21 +578,22 @@ def create_app(engine=None, settings: Settings | None = None,
         m = app.state.metrics
         try:
             try:
-                messages = truncate_messages_to_fit_context(
-                    rd["messages"], settings.max_context_tokens)
+                if rd.get("raw"):
+                    messages = rd["messages"]
+                else:
+                    messages = truncate_messages_to_fit_context(
+                        rd["messages"], settings.max_context_tokens)
                 t0 = time.time()
                 engine = app.state.engine
-                sub_kw = {}
+                sub_kw = _gen_kwargs(rd)
                 if app.state.engine_kw.get("submit_deadline"):
                     sub_kw["deadline"] = rd.get("deadline")
                 if app.state.engine_kw.get("submit_trace"):
                     sub_kw["trace"] = rd.get("trace")
+                if app.state.engine_kw.get("submit_model"):
+                    sub_kw["model"] = rd.get("model")
                 engine_fut = engine.submit(  # lfkt: transfers[engine_fut] -- the scheduler owns the lane: it resolves/reclaims the future via its _items registry even when a failure here skips the await (PR-2 semantics)
                     messages,
-                    temperature=settings.temperature,
-                    top_p=settings.top_p,
-                    frequency_penalty=settings.frequency_penalty,
-                    presence_penalty=settings.presence_penalty,
                     **sub_kw,
                 )
                 if hasattr(engine, "abandon"):
@@ -468,12 +601,23 @@ def create_app(engine=None, settings: Settings | None = None,
                         lambda f: engine.abandon(engine_fut)
                         if f.cancelled() else None)
                 answer = await asyncio.wrap_future(engine_fut)
-                m.observe("generation_seconds", time.time() - t0)
+                m.observe("generation_seconds", time.time() - t0,
+                          model=_model_label(answer))
                 _observe_engine_timings(m, answer)
-                result = _answer_to_text(answer, m)
+                result = _finish_answer(rd, answer, m)
                 err = None
             except HTTPException as e:
                 result, err = None, e
+            except ValueError as e:
+                if rd.get("openai"):
+                    result, err = None, HTTPException(status_code=400,
+                                                      detail=str(e))
+                else:
+                    m.inc("engine_errors_total")
+                    logger.error("Error during message generation: %s", e)
+                    result, err = None, HTTPException(
+                        status_code=500,
+                        detail=f"Error during message generation: {str(e)}")
             except EngineUnavailable as e:
                 # watchdog trip failed this future / scheduler restarting:
                 # retryable 503 (the reference's only answer was pod death)
@@ -519,18 +663,21 @@ def create_app(engine=None, settings: Settings | None = None,
         timings_box: list = []
 
         async def _go():
-            messages = truncate_messages_to_fit_context(
-                rd["messages"], settings.max_context_tokens)
+            if rd.get("raw"):
+                messages = rd["messages"]
+            else:
+                messages = truncate_messages_to_fit_context(
+                    rd["messages"], settings.max_context_tokens)
 
             def run():
                 try:
+                    ckw = _gen_kwargs(rd)
+                    if app.state.engine_kw.get("model"):
+                        ckw["model"] = rd.get("model")
                     it = app.state.engine.create_chat_completion(
                         messages=messages,
                         stream=True,
-                        temperature=settings.temperature,
-                        top_p=settings.top_p,
-                        frequency_penalty=settings.frequency_penalty,
-                        presence_penalty=settings.presence_penalty,
+                        **ckw,
                         **_resilience_kw(rd))
                     try:
                         for chunk in it:
@@ -539,6 +686,10 @@ def create_app(engine=None, settings: Settings | None = None,
                             t = chunk.pop("lfkt_timings", None)
                             if t is not None:
                                 timings_box.append(t)
+                                # the /v1 stream's optional usage chunk
+                                # (stream_options.include_usage) reads the
+                                # finished request's token counts off here
+                                rd["timings"] = t
                             loop.call_soon_threadsafe(chunk_q.put_nowait, chunk)
                         loop.call_soon_threadsafe(
                             chunk_q.put_nowait, _STREAM_DONE)
@@ -549,7 +700,9 @@ def create_app(engine=None, settings: Settings | None = None,
 
             t0 = time.time()
             await asyncio.to_thread(run)
-            m.observe("generation_seconds", time.time() - t0)
+            m.observe("generation_seconds", time.time() - t0,
+                      model=_model_label(
+                          timings_box[0] if timings_box else None))
             m.inc("streamed_generations_total")
             _observe_engine_timings(
                 m, {"lfkt_timings": timings_box[0]} if timings_box else None)
@@ -576,14 +729,23 @@ def create_app(engine=None, settings: Settings | None = None,
         # which resilience kwargs this engine accepts (probed once; fakes
         # and out-of-tree engines may predate the deadline/abort contract)
         ccc = getattr(engine, "create_chat_completion", None)
+        # multi-model routing: ONLY a registry (has_model is its marker)
+        # takes the model= kwarg — plain engines never see it (the alias
+        # was validated at admission, so not forwarding is correct), and
+        # a signature probe would lie for engines with **kwargs
+        # passthroughs (ContinuousEngine.create_chat_completion forwards
+        # **kw into submit/submit_stream, which refuse model=)
+        is_registry = callable(getattr(engine, "has_model", None))
         app.state.engine_kw = {
             "deadline": ccc is not None and _accepts_kwarg(ccc, "deadline"),
             "abort": ccc is not None and _accepts_kwarg(ccc, "abort"),
             "trace": ccc is not None and _accepts_kwarg(ccc, "trace"),
+            "model": ccc is not None and is_registry,
             "submit_deadline": hasattr(engine, "submit") and _accepts_kwarg(
                 engine.submit, "deadline"),
             "submit_trace": hasattr(engine, "submit") and _accepts_kwarg(
                 engine.submit, "trace"),
+            "submit_model": hasattr(engine, "submit") and is_registry,
             "batch_deadlines": hasattr(engine, "create_chat_completions")
             and _accepts_kwarg(engine.create_chat_completions, "deadlines"),
             "batch_traces": hasattr(engine, "create_chat_completions")
@@ -596,6 +758,15 @@ def create_app(engine=None, settings: Settings | None = None,
             engine.metrics_sink = app.state.metrics
         app.state.ready = True
         app.state.health.transition(READY, "engine loaded")
+        if settings.watchdog and getattr(engine, "heartbeat", None) is None \
+                and callable(getattr(engine, "models", None)):
+            # multi-model registry: the engine watchdog is single-engine
+            # (one heartbeat, one recovery contract) and gates off here
+            # with attribution; per-engine scheduler deaths still surface
+            # as EngineUnavailable 503s on their own submit paths
+            logger.info("multi-model registry loaded: engine watchdog "
+                        "gates off (single-engine contract — "
+                        "docs/MULTIMODEL.md)")
         if settings.watchdog and getattr(engine, "heartbeat", None) is not None:
             # local import: engine.watchdog pulls the (jax-heavy) engine
             # package, which this module otherwise defers to the factory
@@ -619,20 +790,17 @@ def create_app(engine=None, settings: Settings | None = None,
             app.state.watchdog.stop()
             app.state.watchdog = None
 
-    def _admit(request_body: BotMessageRequest, request: Request,
-               extra: dict | None = None) -> dict:
-        """Shared admission for both response endpoints: assemble messages
-        (system prompt inserted at index 1 — quirk preserved from reference
-        api.py:147), enqueue with a future, 503 on overflow."""
+    def _enqueue_rd(request: Request, messages: list[dict],
+                    extra: dict | None = None, *, model: str | None = None,
+                    params: dict | None = None, raw: bool = False,
+                    openai: bool = False) -> dict:
+        """Admission core shared by /response and the /v1 facade: enqueue
+        ``messages`` with a future, 503 on overflow.  ``raw`` skips the
+        reference truncation quirks (OpenAI clients own their history);
+        ``params`` carries per-request sampling overrides; ``openai``
+        shapes the result as the full completion dict."""
         queue = request.app.state.queue
         m = request.app.state.metrics
-        messages = [
-            {"role": message.turn, "content": message.message}
-            for message in request_body.context
-        ]
-        system_prompt = build_system_prompt(request_body.bot_profile)
-        messages.insert(1, {"role": "system", "content": system_prompt})
-
         now = time.time()
         # per-request deadline: the admission timeout (or the stream's
         # wall-clock budget) becomes an absolute deadline threaded into the
@@ -648,6 +816,10 @@ def create_app(engine=None, settings: Settings | None = None,
             "enqueued_at": now,
             "deadline": now + budget,
             "trace": trace,
+            "model": model,
+            "params": params,
+            "raw": raw,
+            "openai": openai,
             **(extra or {}),
         }
         try:
@@ -660,8 +832,25 @@ def create_app(engine=None, settings: Settings | None = None,
                                 detail="Server too busy. Please try again later.")
         if trace is not None:
             trace.note(deadline=rd["deadline"])
+            if model is not None:
+                trace.note(model=model)
         m.set_gauge("queue_depth", queue.qsize())
         return rd
+
+    def _admit(request_body: BotMessageRequest, request: Request,
+               extra: dict | None = None) -> dict:
+        """Shared admission for both response endpoints: assemble messages
+        (system prompt inserted at index 1 — quirk preserved from reference
+        api.py:147), validate the optional model alias (400 in the existing
+        {"detail": ...} shape), enqueue with a future, 503 on overflow."""
+        model = _validate_model(request_body.model)
+        messages = [
+            {"role": message.turn, "content": message.message}
+            for message in request_body.context
+        ]
+        system_prompt = build_system_prompt(request_body.bot_profile)
+        messages.insert(1, {"role": "system", "content": system_prompt})
+        return _enqueue_rd(request, messages, extra, model=model)
 
     @app.post("/response")
     async def generate_response(request_body: BotMessageRequest, request: Request):
@@ -745,6 +934,159 @@ def create_app(engine=None, settings: Settings | None = None,
                 app.state.tracer.finish(trace)
 
         return StreamingResponse(sse())
+
+    # -- OpenAI-compatible facade (docs/MULTIMODEL.md) ---------------------
+    # Same admission path as /response (bounded queue → 503, future
+    # timeout → 408, scheduler lanes in continuous mode) behind the wire
+    # contract OpenAI SDKs speak: model routing, chat.completion /
+    # chat.completion.chunk envelopes, usage counts from the engine's own
+    # timings, and the {"error": {...}} body on every failure.
+
+    @app.get("/v1/models")
+    async def v1_models():
+        """The served model manifest, OpenAI list-shaped: one row per
+        registry alias (single-model pods list their one model)."""
+        eng = app.state.engine
+        models_fn = getattr(eng, "models", None)
+        if callable(models_fn):
+            names = [r["name"] for r in models_fn()]
+        else:
+            names = [getattr(eng, "model_name", None)
+                     or app.state.settings.model_name]
+        return {
+            "object": "list",
+            "data": [{"id": n, "object": "model",
+                      "created": app.state.created, "owned_by": "lfkt"}
+                     for n in names],
+        }
+
+    def _v1_params(body: ChatCompletionRequest) -> dict:
+        """The request's explicitly-set sampling fields (unset ones fall
+        back to the pod's serving defaults in _gen_kwargs)."""
+        return {k: v for k, v in dict(
+            temperature=body.temperature,
+            top_p=body.top_p,
+            frequency_penalty=body.frequency_penalty,
+            presence_penalty=body.presence_penalty,
+            max_tokens=body.max_tokens,
+            stop=body.stop,
+            seed=body.seed,
+        ).items() if v is not None}
+
+    def _v1_sse(rd, include_usage: bool):
+        """/v1 streaming body: engine chunks as ``chat.completion.chunk``
+        SSE events, OpenAI error envelopes on failure, an optional final
+        usage chunk (stream_options.include_usage), then ``[DONE]``.
+        Mirrors /response/stream's timeout/disconnect reclamation: the
+        generator's finally cancels the future, which every engine path
+        watches."""
+        m = app.state.metrics
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + settings.stream_deadline_seconds
+        trace = rd.get("trace")
+
+        async def sse():
+            sspan = trace.span("stream") if trace is not None else None
+            n_events = 0
+            last = None
+            try:
+                while True:
+                    gap = min(settings.timeout_seconds, deadline - loop.time())
+                    try:
+                        if gap <= 0:
+                            raise asyncio.TimeoutError
+                        chunk = await asyncio.wait_for(
+                            rd["stream_queue"].get(), timeout=gap)
+                    except asyncio.TimeoutError:
+                        m.inc("requests_timed_out_total")
+                        if sspan is not None:
+                            sspan.event("stream_timeout")
+                        yield ("data: " + json.dumps(_openai_error_body(
+                            408, "Generation timed out")) + "\n\n")
+                        return
+                    if chunk is _STREAM_DONE:
+                        t = rd.get("timings")
+                        if include_usage and t is not None and last is not None:
+                            p, c = t.get("prompt_tokens", 0), \
+                                t.get("completion_tokens", 0)
+                            yield "data: " + json.dumps({
+                                "id": last.get("id"),
+                                "object": "chat.completion.chunk",
+                                "created": last.get("created"),
+                                "model": last.get("model"),
+                                "choices": [],
+                                "usage": {"prompt_tokens": p,
+                                          "completion_tokens": c,
+                                          "total_tokens": p + c},
+                            }) + "\n\n"
+                        yield "data: [DONE]\n\n"
+                        return
+                    if isinstance(chunk, Exception):
+                        status = 400 if isinstance(chunk, ValueError) else 500
+                        yield ("data: " + json.dumps(_openai_error_body(
+                            status, str(chunk))) + "\n\n")
+                        return
+                    last = chunk
+                    n_events += 1
+                    yield "data: " + json.dumps(chunk) + "\n\n"
+            finally:
+                if not rd["future"].done():
+                    rd["future"].cancel()
+                if sspan is not None:
+                    sspan.set(events=n_events)
+                    sspan.end()
+                app.state.tracer.finish(trace)
+
+        return StreamingResponse(sse())
+
+    @app.post("/v1/chat/completions")
+    async def v1_chat_completions(body: ChatCompletionRequest,
+                                  request: Request):
+        """OpenAI-compatible chat completions: non-streaming returns the
+        engine's completion dict (usage counts from its timings);
+        ``stream: true`` emits ``chat.completion.chunk`` SSE.  Unknown
+        ``model`` → 400 with code ``model_not_found``."""
+        m = request.app.state.metrics
+        try:
+            if body.n != 1:
+                raise HTTPException(
+                    status_code=400,
+                    detail="n must be 1: this server returns a single "
+                           "choice per request")
+            if not body.messages:
+                raise HTTPException(status_code=400,
+                                    detail="messages must be non-empty")
+            model = _validate_model(body.model)
+            params = _v1_params(body)
+            messages = [{"role": msg.role, "content": msg.content}
+                        for msg in body.messages]
+            if body.stream:
+                rd = _enqueue_rd(request, messages,
+                                 {"stream_queue": asyncio.Queue()},
+                                 model=model, params=params, raw=True,
+                                 openai=True)
+                return _v1_sse(rd, include_usage=bool(
+                    body.stream_options and
+                    body.stream_options.include_usage))
+            rd = _enqueue_rd(request, messages, model=model, params=params,
+                             raw=True, openai=True)
+            try:
+                answer = await asyncio.wait_for(
+                    rd["future"], timeout=settings.timeout_seconds)
+            except asyncio.TimeoutError:
+                logger.warning("Generation timed out")
+                m.inc("requests_timed_out_total")
+                rd["future"].cancel()
+                raise HTTPException(status_code=408,
+                                    detail="Generation timed out")
+            return JSONResponse(answer)
+        except HTTPException as e:
+            return _openai_http_error(e)
+        except Exception as e:  # noqa: BLE001 — facade contract: every
+            # failure wears the OpenAI error envelope, including bugs
+            logger.error("Internal server error: %s", e)
+            return _openai_http_error(HTTPException(
+                status_code=500, detail=f"Internal server error: {str(e)}"))
 
     def _resilience_info() -> dict:
         """Error-taxonomy + watchdog block for /health: the state machine,
@@ -837,6 +1179,15 @@ def create_app(engine=None, settings: Settings | None = None,
             occ = getattr(eng, "kv_pool_occupancy", None)
             if callable(occ):
                 engine_info["kv_pool"] = occ()
+            # multi-model registry: one row per served model (name, quant,
+            # weight bytes, load state — docs/MULTIMODEL.md) next to the
+            # kv_pool block; absent on single-model pods, whose /health is
+            # byte-for-byte the pre-registry document
+            models_fn = getattr(eng, "models", None)
+            if callable(models_fn):
+                engine_info["models"] = models_fn()
+                engine_info["default_model"] = getattr(
+                    eng, "default_model", None)
             # spec_decode="auto": the measured-RTT decision and its inputs
             # (engine/spec_auto.py) — operators verify the resolution here
             if getattr(eng, "spec_auto_decision", None) is not None:
@@ -866,6 +1217,21 @@ def create_app(engine=None, settings: Settings | None = None,
         kv_bytes = getattr(app.state.engine, "kv_cache_bytes", None)
         if kv_bytes is not None:
             m.set_gauge("kv_cache_bytes", kv_bytes)
+        # multi-model capacity gauges (docs/MULTIMODEL.md): how many
+        # models this pod serves and each one's resident weight bytes
+        models_fn = getattr(app.state.engine, "models", None)
+        if callable(models_fn):
+            rows = models_fn()
+            m.set_gauge("models_loaded", len(rows))
+            for r in rows:
+                m.set_gauge("model_weight_bytes", r["weight_bytes"],
+                            model=r["name"])
+        elif app.state.engine is not None:
+            m.set_gauge("models_loaded", 1)
+            wb = getattr(app.state.engine, "weight_bytes", 0)
+            if wb:
+                m.set_gauge("model_weight_bytes", wb,
+                            model=_model_label())
         # paged KV pool occupancy gauges (the event counters —
         # misses/evictions/spills/restores + the reuse histogram — are
         # inc'd at event time by the pool through the injected sink)
@@ -1061,32 +1427,107 @@ def create_app(engine=None, settings: Settings | None = None,
     return app
 
 
+def _base_engine_kwargs(settings: Settings) -> dict:
+    """Engine-constructor kwargs shared by the single-model factory and
+    every registry entry (which then applies its manifest overrides)."""
+    return dict(
+        n_ctx=settings.max_context_tokens,
+        weight_format=settings.weight_format,
+        decode_chunk=settings.decode_chunk,
+        prefill_buckets=settings.prefill_bucket_list,
+        max_gen_tokens=settings.max_gen_tokens,
+        attn_impl=settings.attn_impl,
+        kv_dtype=settings.kv_dtype,
+        spec_decode=settings.spec_decode,
+        spec_draft=settings.spec_draft,
+        prefix_cache=settings.prefix_cache,
+        prefill_chunk=settings.prefill_chunk,
+        prefill_overlap=settings.prefill_overlap,
+        kv_paged=settings.kv_paged,
+        kv_page_tokens=settings.kv_page_tokens,
+        kv_pool_pages=settings.kv_pool_pages,
+        kv_spill_pages=settings.kv_spill_pages,
+    )
+
+
+def _registry_factory(settings: Settings):
+    """LFKT_MODELS is set: load the manifest into a ModelRegistry
+    (serving/registry.py) — N engines sharing the chip, the paged KV pool
+    (per-model namespaces) and an explicit HBM weight budget, all with
+    the SAME scheduler shape (lanes/chunks/admission come from the
+    process-wide knobs; per-model overrides are whitelisted engine knobs
+    only — serving/manifest.py)."""
+    from ..serving import ModelRegistry, parse_manifest, pick_default
+
+    specs = parse_manifest(settings.models)
+    default = pick_default(specs, settings.default_model)
+    if settings.mesh_sp > 1 and settings.batch_size > 1:
+        # mirror the single-model factory's refusal exactly — a 1-entry
+        # manifest must not soften any serving-shape validation
+        raise ValueError(
+            "LFKT_MESH_SP > 1 serves sequence-parallel (serial); "
+            "set LFKT_BATCH_SIZE=1 or use dp/tp batching instead")
+    if len(specs) > 1 and settings.mesh_sp > 1:
+        raise ValueError(
+            "LFKT_MESH_SP > 1 gates off multi-model serving: the "
+            "sp-sharded ring serves one model per mesh (run one model "
+            "per pod, or drop to mesh_sp=1)")
+    if len(specs) > 1 and settings.batch_size > 1 \
+            and settings.scheduler != "continuous":
+        raise ValueError(
+            "LFKT_SCHEDULER=cycle gates off multi-model serving: a "
+            "mesh-batched cycle coalesces its whole batch into ONE "
+            "shared device program, which cannot interleave models — "
+            "use the continuous scheduler (docs/MULTIMODEL.md)")
+
+    def build(spec, path, shared_pool):
+        from ..engine import ContinuousEngine, Engine, MeshEngine, SPEngine
+
+        kw = _base_engine_kwargs(settings)
+        kw.update(spec.overrides)
+        kw["kv_pool"] = shared_pool
+        kw["kv_namespace"] = spec.name
+        if settings.mesh_sp > 1:
+            return SPEngine(path, sp=settings.mesh_sp, tp=settings.mesh_tp,
+                            **kw)
+        if settings.batch_size > 1:
+            if settings.scheduler == "continuous":
+                kw.pop("prefill_chunk")
+                return ContinuousEngine(
+                    path, tp=settings.mesh_tp,
+                    batch_size=settings.batch_size,
+                    prefill_chunk=settings.prefill_chunk,
+                    adm_budget=settings.adm_budget,
+                    adm_controller=settings.adm_controller,
+                    adm_ema_alpha=settings.adm_ema_alpha,
+                    lane_prefix_cache=settings.lane_prefix_cache, **kw)
+            # cycle scheduler, single-entry manifest: the same
+            # MeshEngine the non-manifest factory builds — a 1-entry
+            # LFKT_MODELS migration must not silently swap schedulers
+            return MeshEngine(path, tp=settings.mesh_tp,
+                              batch_size=settings.batch_size, **kw)
+        return Engine(path, **kw)
+
+    reg = ModelRegistry.from_specs(
+        specs, build, default_model=default, model_dir=settings.model_dir,
+        weight_budget_bytes=int(settings.hbm_weight_budget_mb * 1e6))
+    reg.warmup()
+    return reg
+
+
 def _default_engine_factory(settings: Settings):
     def factory():
         from ..engine import ContinuousEngine, Engine, MeshEngine, SPEngine
 
-        kw = dict(
-            n_ctx=settings.max_context_tokens,
-            weight_format=settings.weight_format,
-            decode_chunk=settings.decode_chunk,
-            prefill_buckets=settings.prefill_bucket_list,
-            max_gen_tokens=settings.max_gen_tokens,
-            attn_impl=settings.attn_impl,
-            kv_dtype=settings.kv_dtype,
-            spec_decode=settings.spec_decode,
-            spec_draft=settings.spec_draft,
-            prefix_cache=settings.prefix_cache,
-            prefill_chunk=settings.prefill_chunk,
-            prefill_overlap=settings.prefill_overlap,
-            kv_paged=settings.kv_paged,
-            kv_page_tokens=settings.kv_page_tokens,
-            kv_pool_pages=settings.kv_pool_pages,
-            kv_spill_pages=settings.kv_spill_pages,
-        )
         if settings.scheduler not in ("continuous", "cycle"):
             raise ValueError(
                 f"LFKT_SCHEDULER must be 'continuous' or 'cycle', "
                 f"got {settings.scheduler!r}")
+        if settings.models:
+            # multi-model manifest: the registry replaces the single
+            # engine; empty LFKT_MODELS keeps this path byte-for-byte
+            return _registry_factory(settings)
+        kw = _base_engine_kwargs(settings)
         if settings.mesh_sp > 1:
             # long-context serving: n_ctx sharded over the sp ring
             if settings.batch_size > 1:

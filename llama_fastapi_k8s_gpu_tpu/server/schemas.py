@@ -1,9 +1,11 @@
 """Request/response schemas — field-for-field the reference's
-``data/requests.py:4-19`` so existing clients keep working unchanged."""
+``data/requests.py:4-19`` so existing clients keep working unchanged,
+plus the OpenAI-compatible ``/v1/chat/completions`` request shape
+(docs/MULTIMODEL.md facade mapping table)."""
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Union
 
 from pydantic import BaseModel
 
@@ -27,3 +29,42 @@ class BotMessageRequest(BaseModel):
     bot_profile: BotProfile
     user_profile: UserProfile
     context: list[ChatMessage]
+    # multi-model routing (docs/MULTIMODEL.md): which manifest alias
+    # serves this request; None = the pod's default model.  Absent from
+    # the reference schema, so existing clients are unchanged — and an
+    # unknown name 400s in the existing {"detail": ...} error shape.
+    model: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# OpenAI-compatible facade (POST /v1/chat/completions)
+# ---------------------------------------------------------------------------
+
+class OpenAIChatMessage(BaseModel):
+    role: str
+    content: str
+
+
+class StreamOptions(BaseModel):
+    include_usage: bool = False
+
+
+class ChatCompletionRequest(BaseModel):
+    """The OpenAI chat-completions request subset this server honors.
+    Sampling fields left unset fall back to the pod's serving defaults
+    (LFKT_TEMPERATURE & co.) — the mapping table lives in
+    docs/MULTIMODEL.md."""
+
+    messages: list[OpenAIChatMessage]
+    model: Optional[str] = None
+    stream: bool = False
+    stream_options: Optional[StreamOptions] = None
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    stop: Optional[Union[str, list[str]]] = None
+    seed: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    n: int = 1
+    user: Optional[str] = None

@@ -1,0 +1,4 @@
+"""Multi-model, multi-tenant serving (docs/MULTIMODEL.md; ROADMAP item 5)."""
+
+from .manifest import OVERRIDE_KEYS, ModelSpec, parse_manifest, pick_default  # noqa: F401
+from .registry import ModelRegistry, UnknownModelError, WeightBudgetError  # noqa: F401

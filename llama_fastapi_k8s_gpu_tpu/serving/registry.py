@@ -1,0 +1,337 @@
+"""The model registry: N named engines served from one process.
+
+ROADMAP item 5's subsystem (docs/MULTIMODEL.md).  The registry
+
+- loads every :class:`~..serving.manifest.ModelSpec` through a
+  caller-supplied ``build`` function (the server factory closes over the
+  process-wide scheduler settings, so every model gets the same serving
+  shape — lanes, chunk cadence, admission control);
+- accounts an explicit **HBM weight budget** across the set and refuses
+  at load time, with per-model attribution, when the fleet cannot fit
+  (``LFKT_HBM_WEIGHT_BUDGET_MB``; a half-loaded fleet OOMing at first
+  traffic is the failure mode this converts into a startup error);
+- threads one **shared block-paged KV pool** through every compatible
+  engine (same per-page cache geometry), so co-resident models partition
+  one HBM page budget dynamically instead of each provisioning
+  worst-case — with per-model radix **namespaces**, so tenant A's system
+  prompt can never produce a phantom prefix hit for tenant B
+  (parallel/kvpool.py);
+- routes per-request ``model=`` to the named engine.  In continuous mode
+  each model owns a scheduler (its own lanes); their device dispatches
+  interleave on the chip's single execution queue, so waves of model A
+  run between waves of model B — the co-resident-deployment shape of
+  "Transformer-Lite" (PAPERS.md).
+
+Gating, with attribution (the SPEngine-paging idiom): the ``cycle``
+(mesh-batched) scheduler and the sequence-parallel engine coalesce
+requests into one shared device program, which cannot interleave
+models — the server factory refuses those combinations at startup.  The
+engine watchdog is likewise single-engine (one heartbeat, one recovery
+path) and does not run over a multi-model registry; per-engine scheduler
+failures still fail fast through ``EngineUnavailable`` on their own
+submit paths.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .manifest import ModelSpec
+
+logger = logging.getLogger(__name__)
+
+
+class UnknownModelError(ValueError):
+    """A request named a model the manifest does not serve (HTTP 400)."""
+
+    def __init__(self, model: str, known: list[str]):
+        self.model = model
+        self.known = list(known)
+        super().__init__(
+            f"unknown model {model!r}; this pod serves: "
+            f"{', '.join(self.known)}")
+
+
+class WeightBudgetError(RuntimeError):
+    """The manifest's weights exceed the declared HBM budget."""
+
+
+#: weight-group leaf key -> served layout; ORDER MATTERS (specific keys
+#: before the generic "q"/"w" fallbacks) — same map /health derives its
+#: per-group weight_formats from (server/app.py)
+_WEIGHT_KINDS = {"qs": "q4k-fused", "q5s": "q5k-fused",
+                 "q5p": "q5k-fused-pre", "q4": "q6k-fused",
+                 "q6p": "q6k-fused-pre", "q8": "q8-fused",
+                 "q": "int8", "w": "bf16"}
+
+
+def _quant_summary(engine) -> str | None:
+    """One label for how the model's linear weights are served (e.g.
+    ``q4k-fused`` or ``bf16+int8`` when groups differ) — the /health
+    ``models`` row's ``quant`` field."""
+    params = getattr(engine, "params", None)
+    if not isinstance(params, dict) or "layers" not in params:
+        return None
+    fmts = {
+        next((v for k, v in _WEIGHT_KINDS.items() if k in leaf), "?")
+        for leaf in params["layers"].values() if isinstance(leaf, dict)
+    }
+    return "+".join(sorted(fmts)) if fmts else None
+
+
+class ModelRegistry:
+    """Named engines behind one engine-shaped facade.
+
+    The server talks to a registry exactly as it talks to a single
+    engine (``create_chat_completion`` / ``submit`` / ``scheduler_stats``
+    / ``kv_cache_bytes`` ...), plus ``model=`` routing and the
+    ``models()`` descriptor that feeds ``GET /v1/models`` and the
+    /health ``models`` block.  ``submit``/``submit_stream``/
+    ``create_chat_completions`` are installed only when every engine
+    provides them, so the server's capability probes keep working.
+    """
+
+    def __init__(self, engines: dict[str, object], default_model: str,
+                 model_info: list[dict] | None = None):
+        if not engines:
+            raise ValueError("ModelRegistry needs at least one engine")
+        if default_model not in engines:
+            raise ValueError(
+                f"default model {default_model!r} is not among "
+                f"{', '.join(engines)}")
+        self._engines = dict(engines)
+        for name, eng in self._engines.items():
+            # the registry alias IS the serving identity: responses,
+            # traces, /debug/requests rows and metric labels all read
+            # model_name (from_specs already did this; direct
+            # construction — tests, embedders — gets it here)
+            try:
+                eng.model_name = name
+            except AttributeError:   # read-only property: keep its label
+                pass
+        self.default_model = default_model
+        #: single-model-compat surface: responses carry their own model
+        #: name; this is only the fallback label (e.g. untimed fakes)
+        self.model_name = default_model
+        self._model_info = list(model_info or [])
+        if not self._model_info:
+            self._model_info = [
+                self._describe(name, eng, path=None)
+                for name, eng in self._engines.items()
+            ]
+        self._metrics_sink = None
+        if all(hasattr(e, "submit") for e in self._engines.values()):
+            self.submit = self._submit
+        if all(hasattr(e, "submit_stream") for e in self._engines.values()):
+            self.submit_stream = self._submit_stream
+        if all(hasattr(e, "create_chat_completions")
+               for e in self._engines.values()):
+            self.create_chat_completions = self._create_chat_completions
+        if all(hasattr(e, "scheduler_stats")
+               for e in self._engines.values()):
+            self.scheduler_stats = self._scheduler_stats
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _describe(name: str, engine, path: str | None) -> dict:
+        cfg = getattr(engine, "cfg", None)
+        return {
+            "name": name,
+            "path": path,
+            "quant": _quant_summary(engine),
+            "weight_bytes": int(getattr(engine, "weight_bytes", 0) or 0),
+            "n_ctx": getattr(cfg, "n_ctx", None),
+            "kv_dtype": getattr(cfg, "kv_dtype", None),
+            "state": "loaded",
+        }
+
+    @classmethod
+    def from_specs(cls, specs: list[ModelSpec], build, *,
+                   default_model: str, model_dir: str = "models",
+                   weight_budget_bytes: int = 0) -> "ModelRegistry":
+        """Load every spec through ``build(spec, path, shared_pool)``,
+        accounting the HBM weight budget as the fleet grows and sharing
+        the first paged engine's KV pool with every later compatible one.
+
+        ``build`` must return an un-warmed engine; call
+        :meth:`warmup` on the returned registry afterwards (budget
+        refusal should cost a load, never a compile sweep)."""
+        engines: dict[str, object] = {}
+        info: list[dict] = []
+        shared_pool = None
+        used = 0
+        for spec in specs:
+            path = spec.resolved_path(model_dir)
+            eng = build(spec, path, shared_pool)
+            # responses, traces, /debug/requests rows and metric labels
+            # all read model_name — the manifest alias IS the serving
+            # identity, not the GGUF's embedded general.name
+            eng.model_name = spec.name
+            row = cls._describe(spec.name, eng, path=path)
+            used += row["weight_bytes"]
+            if weight_budget_bytes and used > weight_budget_bytes:
+                table = ", ".join(
+                    f"{r['name']}={r['weight_bytes'] / 1e6:.0f}MB"
+                    for r in info + [row])
+                raise WeightBudgetError(
+                    f"HBM weight budget exhausted loading {spec.name!r}: "
+                    f"{used / 1e6:.0f}MB of weights vs "
+                    f"LFKT_HBM_WEIGHT_BUDGET_MB="
+                    f"{weight_budget_bytes / 1e6:.0f}MB ({table}); shrink "
+                    "the manifest, quantize harder, or raise the budget "
+                    "(docs/MULTIMODEL.md)")
+            engines[spec.name] = eng
+            info.append(row)
+            if shared_pool is None:
+                shared_pool = getattr(eng, "_kvpool", None)
+        logger.info(
+            "model registry: %d models, %.0fMB weights%s (default=%s)",
+            len(engines), used / 1e6,
+            f" of {weight_budget_bytes / 1e6:.0f}MB budget"
+            if weight_budget_bytes else "", default_model)
+        return cls(engines, default_model, model_info=info)
+
+    # -- routing --------------------------------------------------------
+    def model_names(self) -> list[str]:
+        return list(self._engines)
+
+    def has_model(self, name: str) -> bool:
+        return name in self._engines
+
+    def resolve(self, model: str | None):
+        """The engine serving ``model`` (None = the default alias)."""
+        name = model or self.default_model
+        eng = self._engines.get(name)
+        if eng is None:
+            raise UnknownModelError(name, list(self._engines))
+        return eng
+
+    def models(self) -> list[dict]:
+        """Manifest descriptor rows — ``GET /v1/models`` and the /health
+        ``models`` block (name, quant, weight bytes, load state)."""
+        return [dict(r) for r in self._model_info]
+
+    # -- engine-shaped facade -------------------------------------------
+    def create_chat_completion(self, messages, stream: bool = False, *,
+                               model: str | None = None, **kw):
+        return self.resolve(model).create_chat_completion(
+            messages, stream=stream, **kw)
+
+    def _submit(self, messages, *, model: str | None = None, **kw):
+        eng = self.resolve(model)
+        fut = eng.submit(messages, **kw)
+        fut._lfkt_engine = eng           # abandon() routes through this
+        return fut
+
+    def _submit_stream(self, messages, *, model: str | None = None, **kw):
+        return self.resolve(model).submit_stream(messages, **kw)
+
+    def _create_chat_completions(self, batch_messages, *,
+                                 model: str | None = None, **kw):
+        return self.resolve(model).create_chat_completions(
+            batch_messages, **kw)
+
+    def abandon(self, fut) -> None:
+        eng = getattr(fut, "_lfkt_engine", None)
+        if eng is not None and hasattr(eng, "abandon"):
+            eng.abandon(fut)
+
+    def warmup(self) -> None:
+        for name, eng in self._engines.items():
+            logger.info("warming up model %r", name)
+            eng.warmup()
+
+    def shutdown(self) -> None:
+        for eng in self._engines.values():
+            if hasattr(eng, "shutdown"):
+                eng.shutdown()
+
+    # -- telemetry fan-in/out -------------------------------------------
+    @property
+    def metrics_sink(self):
+        return self._metrics_sink
+
+    @metrics_sink.setter
+    def metrics_sink(self, sink) -> None:
+        self._metrics_sink = sink
+        for eng in self._engines.values():
+            if hasattr(eng, "metrics_sink"):
+                eng.metrics_sink = sink
+
+    def _pools(self) -> list:
+        """Distinct KV pools across the fleet (shared pools once)."""
+        seen: dict[int, object] = {}
+        for eng in self._engines.values():
+            pool = getattr(eng, "_kvpool", None)
+            if pool is not None:
+                seen[id(pool)] = pool
+        return list(seen.values())
+
+    @property
+    def kv_cache_bytes(self) -> int:
+        """Fleet-wide resident KV bytes: per-engine rings/state plus each
+        DISTINCT pool arena once (engines sharing a pool each report the
+        arena in their own figure — deduplicate it here)."""
+        total = 0
+        pool_refs: dict[int, list] = {}
+        for eng in self._engines.values():
+            total += int(getattr(eng, "kv_cache_bytes", 0) or 0)
+            pool = getattr(eng, "_kvpool", None)
+            if pool is not None:
+                entry = pool_refs.setdefault(id(pool), [pool, 0])
+                entry[1] += 1
+        for pool, n in pool_refs.values():
+            total -= (n - 1) * pool.arena_nbytes
+        return total
+
+    #: per-pool descriptive (NON-additive) occupancy fields: summing
+    #: them across heterogeneous pools would report nonsense geometry —
+    #: the merged document lists them per pool instead
+    _POOL_DESCRIPTIVE = ("page_tokens", "page_bytes")
+
+    def kv_pool_occupancy(self) -> dict | None:
+        """Merged pool occupancy + counters for /health and the
+        ``kv_pool_pages_*`` gauges: the single shared pool verbatim (the
+        common case); when geometry split the fleet across pools, the
+        additive fields (page/spill counts, byte totals, event counters)
+        are summed and the descriptive ones (page geometry) listed per
+        pool under ``per_pool`` (``pools`` says how many)."""
+        pools = self._pools()
+        if not pools:
+            return None
+        if len(pools) == 1:
+            p = pools[0]
+            return {**p.occupancy(), **p.stats(), "pools": 1}
+        out: dict = {"pools": len(pools), "per_pool": []}
+        for p in pools:
+            occ = p.occupancy()
+            out["per_pool"].append(
+                {k: occ[k] for k in self._POOL_DESCRIPTIVE})
+            for k, v in {**occ, **p.stats()}.items():
+                if k in self._POOL_DESCRIPTIVE:
+                    continue
+                if isinstance(v, (int, float)):
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    def _scheduler_stats(self) -> dict:
+        """Per-model scheduler stats flattened under the model name
+        (``scheduler_<model>_<stat>`` gauges), plus the fleet-level
+        ``adm_budget_tokens``/``lane_idle_seconds`` the HPA scales on
+        (summed: total scheduler pressure across co-resident models)."""
+        out: dict = {"models": len(self._engines)}
+        budget = 0
+        idle = 0.0
+        for name, eng in self._engines.items():
+            stats = eng.scheduler_stats()
+            budget += stats.get("adm_budget_tokens", 0)
+            idle += stats.get("lane_idle_seconds", 0.0)
+            for k, v in stats.items():
+                if isinstance(v, dict):        # nested (spec): one level
+                    for kk, vv in v.items():
+                        out[f"{name}_{k}_{kk}"] = vv
+                else:
+                    out[f"{name}_{k}"] = v
+        out["adm_budget_tokens"] = budget
+        out["lane_idle_seconds"] = round(idle, 3)
+        return out

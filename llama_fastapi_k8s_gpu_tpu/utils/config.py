@@ -57,6 +57,18 @@ class Settings:
     # Identical defaults to reference api.py:13-19.
     model_dir: str = "models"
     model_name: str = "Lexi-Llama-3-8B-Uncensored_Q4_K_M.gguf"
+    # -- multi-model serving (docs/MULTIMODEL.md; ROADMAP item 5) ----------
+    # declarative model manifest: name=path[:knob=value;...] entries,
+    # comma-separated (serving/manifest.py).  Empty (the default) keeps the
+    # single-model LFKT_MODEL_DIR/LFKT_MODEL_NAME path byte-for-byte.
+    models: str = ""
+    # the alias served when a request names no model= (default: the
+    # manifest's first entry)
+    default_model: str = ""
+    # HBM budget for the fleet's WEIGHTS, in MB (0 = unlimited): the
+    # registry refuses at load time, with per-model attribution, when the
+    # manifest cannot fit — instead of OOMing at first traffic
+    hbm_weight_budget_mb: float = 0.0
     max_context_tokens: int = 1024
     timeout_seconds: float = 25.0
     max_queue_size: int = 5
@@ -239,6 +251,16 @@ KNOBS: dict[str, Knob] = _register(
     # -- Settings-backed (reference-parity serving surface) ----------------
     Knob("LFKT_MODEL_DIR", str, "GGUF directory", serving=True),
     Knob("LFKT_MODEL_NAME", str, "GGUF file name", serving=True),
+    # -- multi-model serving (docs/MULTIMODEL.md) --------------------------
+    Knob("LFKT_MODELS", str,
+         "multi-model manifest: name=path[:knob=value;...],... "
+         "(empty = single-model LFKT_MODEL_NAME)", serving=True),
+    Knob("LFKT_DEFAULT_MODEL", str,
+         "alias served when a request names no model= "
+         "(default: first manifest entry)", serving=True),
+    Knob("LFKT_HBM_WEIGHT_BUDGET_MB", float,
+         "HBM budget for the fleet's weights, MB (0 = unlimited); "
+         "exceeded = load-time refusal with attribution", serving=True),
     Knob("LFKT_MAX_CONTEXT_TOKENS", int, "context window", serving=True),
     Knob("LFKT_TIMEOUT_SECONDS", float, "admission future timeout (408)",
          serving=True),

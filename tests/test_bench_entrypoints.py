@@ -189,6 +189,31 @@ def test_bench_server_batch_multiturn_smoke():
     assert "lane_prefix_hits" in parsed["scheduler_stats"], parsed
 
 
+def test_bench_server_mixed_models_smoke():
+    """The mixed-model arm (LFKT_BENCH_MIXED_MODELS x LFKT_BENCH_BATCH):
+    two continuous engines behind a ModelRegistry, model= alternating
+    across lanes via /v1/chat/completions, per-model aggregate tok/s in
+    the provenance-stamped result (docs/MULTIMODEL.md)."""
+    parsed, out = _run("bench_server.py",
+                       extra_env={"LFKT_BENCH_MIXED_MODELS": "1",
+                                  "LFKT_BENCH_BATCH": "2",
+                                  "LFKT_BENCH_N_REQ": "4",
+                                  "LFKT_BENCH_MAX_TOKENS": "12",
+                                  "LFKT_BENCH_PORT": "8043"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert parsed["value"] > 0
+    assert set(parsed["per_model"]) == {"alpha", "beta"}
+    for name in ("alpha", "beta"):
+        pm = parsed["per_model"][name]
+        assert pm["completed"] > 0 and pm["errors"] == 0, parsed
+        assert pm["agg_tok_s"] > 0 and pm["gen_tokens"] > 0, parsed
+    # the merged scheduler stats carry per-model keys + the HPA gauges
+    stats = parsed["scheduler_stats"]
+    assert stats["models"] == 2
+    assert "alpha_lanes_live" in stats and "beta_lanes_live" in stats
+    assert "adm_budget_tokens" in stats and "lane_idle_seconds" in stats
+
+
 def test_synth_q4km_layouts_match_prep():
     """The q4km synthetic grid must stay layout-identical (pytree keys,
     shapes, dtypes) to what models/params.py builds from a real Q4_K_M

@@ -74,15 +74,15 @@ def test_latency_slo_burns_when_tail_exceeds_threshold():
     s = _engine(m)
     s.evaluate(now=0.0)                    # baseline: both windows realize
     for _ in range(18):
-        m.observe("engine_ttft_seconds", 0.05, bucket="128")
+        m.observe("engine_ttft_seconds", 0.05, bucket="128", model="m")
     for _ in range(2):                     # 10% of events over the bound
-        m.observe("engine_ttft_seconds", 1.8, bucket="128")
+        m.observe("engine_ttft_seconds", 1.8, bucket="128", model="m")
     doc = s.evaluate(now=1_000.0)
     ttft = _slo(doc, "ttft_p95")
     for ev in ttft["windows"].values():
         # bad_frac 0.1 over a 0.05 budget = burn 2.0
         assert ev["burn_rate"] == pytest.approx(2.0, rel=1e-3)
-        assert ev["worst_series"] == "128"
+        assert ev["worst_series"] == "128,m"
         assert "truncated" not in ev       # both windows genuinely elapsed
     assert ttft["verdict"] == "breach"     # burning on EVERY window
     assert doc["verdict"] == "breach"
@@ -96,10 +96,10 @@ def test_window_units_short_burn_is_warn_not_breach():
     s = _engine(m, windows=(60.0, 600.0))
     s.evaluate(now=0.0)                           # baseline A (empty)
     for _ in range(903):
-        m.observe("engine_ttft_seconds", 0.05, bucket="128")
+        m.observe("engine_ttft_seconds", 0.05, bucket="128", model="m")
     s.evaluate(now=540.0)                         # baseline B (all good)
     for _ in range(3):
-        m.observe("engine_ttft_seconds", 1.8, bucket="128")
+        m.observe("engine_ttft_seconds", 1.8, bucket="128", model="m")
     doc = s.evaluate(now=600.0)
     ttft = _slo(doc, "ttft_p95")
     assert ttft["windows"]["60s"]["burn_rate"] >= 1.0        # 3/3 bad
@@ -113,9 +113,9 @@ def test_floor_slo_counts_slow_decodes_as_bad():
     s = _engine(m)
     s.evaluate(now=0.0)                    # baseline: both windows realize
     for _ in range(8):
-        m.observe("engine_decode_tokens_per_sec", 50.0)
+        m.observe("engine_decode_tokens_per_sec", 50.0, model="m")
     for _ in range(2):                     # below the 10 tok/s floor
-        m.observe("engine_decode_tokens_per_sec", 2.0)
+        m.observe("engine_decode_tokens_per_sec", 2.0, model="m")
     doc = s.evaluate(now=700.0)
     floor = _slo(doc, "decode_floor")
     ev = floor["windows"]["60s"]
@@ -132,7 +132,7 @@ def test_truncated_window_cannot_confirm_breach():
     s = _engine(m)                         # windows 60 s / 600 s
     s.evaluate(now=0.0)                    # boot snapshot
     for _ in range(20):
-        m.observe("engine_ttft_seconds", 1.8, bucket="128")  # all bad
+        m.observe("engine_ttft_seconds", 1.8, bucket="128", model="m")  # all bad
     doc = s.evaluate(now=120.0)            # 2 min after boot
     ttft = _slo(doc, "ttft_p95")
     assert ttft["windows"]["60s"]["burn_rate"] >= 1.0
@@ -143,7 +143,7 @@ def test_truncated_window_cannot_confirm_breach():
     assert doc["verdict"] == "warn"
     # once the burn has genuinely lasted the long window, it breaches
     for _ in range(20):
-        m.observe("engine_ttft_seconds", 1.8, bucket="128")
+        m.observe("engine_ttft_seconds", 1.8, bucket="128", model="m")
     doc = s.evaluate(now=650.0)
     assert _slo(doc, "ttft_p95")["verdict"] == "breach"
 
@@ -184,14 +184,14 @@ def test_worst_bucket_series_wins():
     m = Metrics()
     s = _engine(m)
     for _ in range(10):
-        m.observe("engine_ttft_seconds", 0.05, bucket="128")   # healthy
+        m.observe("engine_ttft_seconds", 0.05, bucket="128", model="m")   # healthy
     for _ in range(10):
-        m.observe("engine_ttft_seconds", 1.8, bucket="1024")   # all bad
+        m.observe("engine_ttft_seconds", 1.8, bucket="1024", model="m")   # all bad
     doc = s.evaluate(now=3.0)
     ev = _slo(doc, "ttft_p95")["windows"]["60s"]
-    assert ev["worst_series"] == "1024"
-    assert ev["series"]["128"] == 0.0
-    assert ev["series"]["1024"] == pytest.approx(20.0, rel=1e-3)
+    assert ev["worst_series"] == "1024,m"
+    assert ev["series"]["128,m"] == 0.0
+    assert ev["series"]["1024,m"] == pytest.approx(20.0, rel=1e-3)
 
 
 def test_no_traffic_is_ok_not_breach():
