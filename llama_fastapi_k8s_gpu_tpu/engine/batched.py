@@ -43,10 +43,18 @@ from ..sampling.sample import (
     sampling_tensors,
     seed_window,
 )
+from ..obs.memledger import register_component
+from ..parallel.batched import state_nbytes
 from ..utils.faults import FAULTS
 from .engine import Engine
 
 logger = logging.getLogger(__name__)
+
+
+def _ledger_lane_bytes(eng: "MeshEngine") -> int:
+    """Memory-ledger provider: the batched lane state's resident bytes
+    (snapshot-time metadata read — obs/memledger.py)."""
+    return state_nbytes(getattr(eng, "_bstate", None))
 
 
 @functools.partial(jax.jit, static_argnames=("top_k",))
@@ -97,6 +105,10 @@ class MeshEngine(Engine):
         state = init_batched_state(self.cfg, self.batch_size)
         self._bstate = jax.device_put(
             state, state_shardings(self.cfg, self.mesh, batched=True))
+        # lfkt-mem: the shared lane state is this engine family's biggest
+        # serving allocation — attribute it (provider reads the live
+        # reference, so watchdog re-inits stay correct automatically)
+        register_component("kv_lanes", self, _ledger_lane_bytes)
 
     def _recover_locked(self) -> None:  # lfkt: holds[_lock]
         """Watchdog recovery: a crash mid-cycle may have poisoned the donated

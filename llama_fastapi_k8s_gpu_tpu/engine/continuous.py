@@ -34,7 +34,10 @@ import numpy as np
 
 from ..models.generate import prefill_chunk_jit, sample_jit
 from ..models.llama import init_cache
+from ..obs import memledger as _memledger
 from ..obs.devtime import timed_jit
+from ..obs.memledger import register_component, tree_nbytes
+from ..obs.trace import annotate_all_inflight
 from ..parallel.batched import (
     batched_generate_chunk_perlane_jit,
     batched_spec_verify_perlane_jit,
@@ -46,6 +49,12 @@ from .batched import MeshEngine
 from .engine import Engine
 
 logger = logging.getLogger(__name__)
+
+
+def _ledger_scratch_bytes(eng: "ContinuousEngine") -> int:
+    """Memory-ledger provider: the admission scratch ring's resident
+    bytes (snapshot-time metadata read — obs/memledger.py)."""
+    return tree_nbytes(getattr(eng, "_scratch_cache", None))
 
 
 @functools.partial(jax.jit, donate_argnames=("state", "lane_st"))
@@ -138,9 +147,12 @@ class AdmissionController:
         self.waves = 0
 
     def observe_wave(self, lanes_live: int, fetch_wait_s: float,
-                     wave_s: float) -> int:
+                     wave_s: float, mem_pressure: bool = False) -> int:
         """Fold one scheduler wave's measurements in; returns the budget
-        for the NEXT wave."""
+        for the NEXT wave.  ``mem_pressure`` is the memory ledger's HBM
+        headroom verdict (obs/memledger.py): low headroom forces the cut
+        branch regardless of idle lanes — admitting prefill into a chip
+        about to OOM converts a latency problem into a dead pod."""
         a = self.alpha
         idle = 1.0 - min(lanes_live, self.lanes) / self.lanes
         pressure = min(1.0, fetch_wait_s / wave_s) if wave_s > 0 else 0.0
@@ -155,7 +167,7 @@ class AdmissionController:
             self.ema_idle += a * (idle - self.ema_idle)
             self.ema_pressure += a * (pressure - self.ema_pressure)
         self.waves += 1
-        if self.ema_pressure > self.HIGH_PRESSURE:
+        if mem_pressure or self.ema_pressure > self.HIGH_PRESSURE:
             # decode saturates the device: halve, floor at one slice.
             # Takes PRIORITY over idle — free lanes under saturation mean
             # decode can't keep up, and more prefill only starves it.
@@ -264,7 +276,7 @@ class ContinuousEngine(MeshEngine):
     _THREAD_CONFINED = (
         "_bstate", "_lane_st", "_scratch_cache", "_adm", "_lane_claims",
         "_prefix_stats", "_spec_stats", "_stats", "_loop_error",
-        "_adm_budget", "_lane_idle_s",
+        "_adm_budget", "_lane_idle_s", "_mem_hot_prev",
     )
     # cross-thread by design; individual operations are GIL-atomic
     # (dict/Queue/Event ops) or single reference stores
@@ -331,6 +343,12 @@ class ContinuousEngine(MeshEngine):
         self._prefix_stats = {f"{self._reuse_stat}_hits": 0,
                               f"{self._reuse_stat}_reused_tokens": 0}
         self._scratch_cache = init_cache(self.cfg)
+        # lfkt-mem: attribute the persistent prefill scratch (the lane
+        # state rode MeshEngine's registration; the serial ring the base's)
+        register_component("kv_scratch", self, _ledger_scratch_bytes)
+        #: previous wave's memory-pressure verdict: the rising edge emits
+        #: ONE mem_pressure trace event + counter, not one per wave
+        self._mem_hot_prev = False
         base_st = sampling_tensors(SamplingParams())
         self._lane_st = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (self.batch_size,)), base_st)
@@ -1177,6 +1195,27 @@ class ContinuousEngine(MeshEngine):
                 break   # static mode: long admission yields after one slice
         return progressed
 
+    def _note_mem_pressure(self) -> None:
+        """Rising edge of the HBM-pressure signal: count it and stamp
+        every in-flight trace with the headroom numbers — the budget cuts
+        this wave starts are then self-explaining in the waterfall
+        (tools/trace_report.py renders mem_pressure with byte counts)."""
+        hr = _memledger.MEMLEDGER.last_headroom
+        attrs = {}
+        if hr is not None:
+            attrs = {"headroom_bytes": hr[0], "limit_bytes": hr[1]}
+        logger.warning(
+            "HBM memory pressure: admission budget cut (headroom %s of "
+            "%s bytes — docs/RUNBOOK.md 'Diagnosing HBM OOM')",
+            attrs.get("headroom_bytes", "?"), attrs.get("limit_bytes", "?"))
+        annotate_all_inflight("mem_pressure", **attrs)
+        m = self.metrics_sink
+        if m is not None:
+            try:
+                m.inc("mem_pressure_events_total")
+            except Exception:  # noqa: BLE001 — telemetry must never fail serving
+                pass
+
     def scheduler_stats(self) -> dict:
         """Point-in-time scheduler occupancy for ``/metrics`` (lanes_live,
         pending queue depth, whether an admission prefill is in flight,
@@ -1413,14 +1452,24 @@ class ContinuousEngine(MeshEngine):
                 now = time.time()
                 wave_s = max(now - t_prev_wave, 0.0)
                 t_prev_wave = now
+                mem_hot = False
                 if dispatched is not None:
                     live_wave = sum(s is not None for s in dispatched[0])
                     # idle lane-seconds: free lanes while others decode are
                     # lost throughput (the admission controller's raw signal)
                     self._lane_idle_s += (B - live_wave) * wave_s
                     if self._adm_ctl is not None:
+                        # HBM headroom joins the wave signals (lfkt-mem):
+                        # disarmed/stat-less, pressure() is one attribute
+                        # read returning False — nothing on this path
+                        # allocates (poisoned-ledger pin)
+                        mem_hot = _memledger.MEMLEDGER.pressure()
                         self._adm_budget = self._adm_ctl.observe_wave(
-                            live_wave, fetch_wait, wave_s)
+                            live_wave, fetch_wait, wave_s,
+                            mem_pressure=mem_hot)
+                        if mem_hot and not self._mem_hot_prev:
+                            self._note_mem_pressure()
+                        self._mem_hot_prev = mem_hot
                 pending = dispatched
                 stats = {
                     "lanes_live": sum(s is not None for s in slots),
@@ -1428,6 +1477,7 @@ class ContinuousEngine(MeshEngine):
                     "admission_inflight": int(self._adm is not None),
                     "adm_budget_tokens": self._adm_budget,
                     "lane_idle_seconds": round(self._lane_idle_s, 3),
+                    "mem_pressure": int(mem_hot),
                 }
                 if self._adm_ctl is not None:
                     stats.update(self._adm_ctl.stats())

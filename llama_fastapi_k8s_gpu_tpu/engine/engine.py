@@ -43,6 +43,7 @@ from ..models.llama import init_cache
 from ..models.params import load_params, synth_params
 from ..sampling.sample import SamplingParams, sampling_tensors, seed_window
 from ..tokenizer import apply_chat_template, detect_chat_template, tokenizer_from_gguf
+from ..obs.memledger import register_component, tree_nbytes
 from ..utils.faults import FAULTS
 from ..utils.health import Heartbeat
 from ..utils.jaxcache import setup_compile_cache
@@ -53,6 +54,20 @@ logger = logging.getLogger(__name__)
 DEFAULT_BUCKETS = (128, 256, 512, 1024)
 
 
+# -- memory-ledger providers (obs/memledger.py): called at snapshot time
+# from scrape/incident threads; plain metadata reads of live attributes,
+# so they need no lock (the kv_cache_bytes precedent) -----------------------
+
+def _ledger_weight_bytes(eng: "Engine") -> int:
+    # tree_nbytes, not weight_bytes: the ledger reconciles against what
+    # the devices physically hold, so tp-replicated leaves count one
+    # copy per chip (weight_bytes stays the LOGICAL figure the registry's
+    # budget is defined over)
+    return tree_nbytes(getattr(eng, "params", None))
+
+
+def _ledger_ring_bytes(eng: "Engine") -> int:
+    return tree_nbytes(getattr(eng, "_cache", None))
 
 
 class _TextEmitter:
@@ -430,6 +445,13 @@ class Engine:
                     sink_host=self)
         else:
             self._kvpool = None
+        # -- lfkt-mem: report this engine's allocation surfaces into the
+        # process memory ledger (obs/memledger.py).  Weakly held — a
+        # discarded engine's rows vanish with it; providers read live
+        # shape metadata at snapshot time, never on the decode path.
+        # (The pool registers itself; subclasses add their own surfaces.)
+        register_component("weights", self, _ledger_weight_bytes)
+        register_component("kv_ring", self, _ledger_ring_bytes)
 
     # ------------------------------------------------------------------
     @property

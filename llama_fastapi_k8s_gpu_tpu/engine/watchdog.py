@@ -34,6 +34,7 @@ import logging
 import threading
 import time
 
+from ..obs import flightrec as _flightrec
 from ..obs.trace import annotate_all_inflight
 from ..utils.health import DEAD, DEGRADED, READY, EngineUnavailable
 
@@ -85,6 +86,11 @@ class Watchdog:
         self._last_trip_at: float | None = None
         self.last_trip_reason: str | None = None
         self._stop = threading.Event()
+        # the flight recorder's bundles carry the health-transition trail
+        # and scheduler stats via weakly-held refs; the watchdog owns the
+        # authoritative pair for this engine, so install them here — this
+        # also covers in-process drills that never build a server app
+        _flightrec.FLIGHTREC.install(health=health, engine=engine)
         self._thread = threading.Thread(
             target=self._loop, name="lfkt-watchdog", daemon=True)
 
@@ -120,6 +126,16 @@ class Watchdog:
                     f"in {self.error_window:.0f}s ({hb.last_error})")
         return None
 
+    def _record_incident(self, kind: str, reason: str) -> None:
+        """Bundle this incident (obs/flightrec.py).  The health snapshot
+        and scheduler stats ride the bundle's top-level fields via the
+        recorder's installed refs (see __init__) — ``extra`` carries only
+        the watchdog's own counters, so nothing is captured twice."""
+        _flightrec.record_incident(kind, reason, extra={"watchdog": {
+            "trips": self.trips, "trips_window": self.trips_window,
+            "max_recoveries": self.max_recoveries,
+        }})
+
     def handle_trip(self, reason: str) -> None:
         """DEGRADED → fail in-flight → backoff → recover (or escalate)."""
         self.trips += 1
@@ -133,6 +149,11 @@ class Watchdog:
         annotate_all_inflight("watchdog_trip", trip=self.trips,
                               reason=reason)
         self.health.transition(DEGRADED, reason)
+        # flight recorder (obs/flightrec.py): snapshot the incident BEFORE
+        # failing in-flight futures, so the tripping request's trace is
+        # still in the bundle.  Disarmed (no LFKT_INCIDENT_DIR) this is a
+        # single attribute read inside record().
+        self._record_incident("watchdog_trip", reason)
         hb = getattr(self.engine, "heartbeat", None)
         if hb is not None:
             # the burst evidence is consumed by this trip: re-tripping must
@@ -156,6 +177,11 @@ class Watchdog:
                          self.trips_window, self.max_recoveries)
             self.health.transition(
                 DEAD, f"max_recoveries_exceeded after: {reason}")
+            # the pod is about to fail its liveness probe and restart:
+            # this bundle is the only evidence that survives it
+            self._record_incident(
+                "dead_escalation",
+                f"max_recoveries_exceeded after: {reason}")
             self._stop.set()
             return
 

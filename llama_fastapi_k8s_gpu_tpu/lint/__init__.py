@@ -21,10 +21,12 @@ This package checks them at test time, on CPU, stdlib-``ast`` only:
 - :mod:`.configreg` — CFG001-005: every LFKT_* env read routes through the
                       utils/config.py registry; registry ↔ docs ↔ Helm
                       three-way cross-check; probe routes exist.
-- :mod:`.obsreg`    — OBS001-002: every metric name recorded into
+- :mod:`.obsreg`    — OBS001-003: every metric name recorded into
                       utils/metrics.py appears in the obs/catalog.py
-                      metric catalog, and the catalog is fully documented
-                      (the docs table is generated from it).
+                      metric catalog, the catalog is fully documented
+                      (the docs table is generated from it), and every
+                      memory-ledger ``register_component`` name appears
+                      in the MEM_COMPONENTS catalog (obs/memledger.py).
 - :mod:`.kernels`   — KER001-003: Pallas kernels carry an interpret gate,
                       a probe or XLA fallback, and static block shapes.
 - :mod:`.perf`      — PERF001-002: every jit/pallas entry point is

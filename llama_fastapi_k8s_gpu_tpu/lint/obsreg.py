@@ -32,10 +32,13 @@ RULES = {
     "OBS001": "metric name recorded via inc/observe/set_gauge is missing "
               "from the obs/catalog.py metric catalog",
     "OBS002": "cataloged metric is documented nowhere under README/docs",
+    "OBS003": "memory-ledger register_component name is missing from the "
+              "obs/catalog.py MEM_COMPONENTS catalog",
 }
 
 CATALOG_REL = "obs/catalog.py"
 _RECORDERS = ("inc", "observe", "set_gauge")
+_LEDGER_REGISTRAR = "register_component"
 
 
 def _catalog(ctx: Context) -> tuple[dict[str, dict], bool]:
@@ -59,6 +62,25 @@ def _catalog(ctx: Context) -> tuple[dict[str, dict], bool]:
                         metrics[name] = {"prefix": prefix}
         return metrics, True
     return metrics, False
+
+
+def _components(ctx: Context) -> tuple[set, bool]:
+    """(component names, found): parsed statically from the
+    ``MemComponent(...)`` literals in obs/catalog.py — the OBS003 twin of
+    :func:`_catalog` (memory ledger, obs/memledger.py)."""
+    names: set = set()
+    for src in ctx.sources:
+        if src.rel != CATALOG_REL:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                f = dotted(node.func)
+                if f and f.split(".")[-1] == "MemComponent" and node.args:
+                    name = const_str(node.args[0])
+                    if name:
+                        names.add(name)
+        return names, True
+    return names, False
 
 
 def _covered(name: str, metrics: dict[str, dict]) -> bool:
@@ -107,6 +129,29 @@ def check(ctx: Context) -> list[Finding]:
                     f"metric {name!r} is not in the obs/catalog.py metric "
                     "catalog; register it (typo'd names mint silent "
                     "series)"))
+
+    # -- OBS003: ledger registrations resolve against MEM_COMPONENTS -------
+    components, have_components = _components(ctx)
+    if have_components:
+        for src in ctx.sources:
+            if src.rel == CATALOG_REL:
+                continue
+            path = ctx.display_path(src)
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                f = dotted(node.func)
+                if f is None or f.split(".")[-1] != _LEDGER_REGISTRAR:
+                    continue
+                name = const_str(node.args[0])
+                if name is None:        # dynamic name: runtime KeyError
+                    continue
+                if name not in components:
+                    out.append(Finding(
+                        "OBS003", path, node.lineno,
+                        f"memory component {name!r} is not in the "
+                        "obs/catalog.py MEM_COMPONENTS catalog; register "
+                        "it (unknown components KeyError at runtime)"))
 
     # -- OBS002: catalog -> docs coverage ----------------------------------
     if not ctx.repo_root:

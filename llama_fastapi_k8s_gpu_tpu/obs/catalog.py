@@ -154,6 +154,33 @@ METRICS: dict[str, Metric] = _register(
     Metric("engine_error_count", GAUGE, "heartbeat errors_total"),
     # -- capacity ----------------------------------------------------------
     Metric("kv_cache_bytes", GAUGE, "resident KV-cache HBM bytes"),
+    # -- lfkt-mem: live HBM memory ledger (obs/memledger.py) ---------------
+    Metric("hbm_bytes", GAUGE,
+           "live HBM bytes per memory-ledger component and model "
+           "(component=residual carries bytes the ledger cannot "
+           "attribute vs device ground truth; docs/OBSERVABILITY.md "
+           "memory-ledger section)",
+           labels=("component", "model")),
+    Metric("hbm_headroom_bytes", GAUGE,
+           "free device HBM (bytes_limit - bytes_in_use); only exported "
+           "where the backend reports memory_stats"),
+    Metric("mem_pressure_events_total", COUNTER,
+           "admission-controller budget cuts triggered by low HBM "
+           "headroom (rising edges, not waves — docs/RUNBOOK.md "
+           "'Diagnosing HBM OOM')"),
+    # -- lfkt-mem: incident flight recorder (obs/flightrec.py) -------------
+    Metric("incidents_total", GAUGE,
+           "incident bundles recorded by the flight recorder this "
+           "process (snapshot; bundles live in LFKT_INCIDENT_DIR)"),
+    # -- multi-tenant token metering (server/app.py usage counts) ----------
+    Metric("tokens_prompt_total", COUNTER,
+           "prompt tokens ingested, by model (from the engines' own "
+           "usage counts — metering without scraping /v1 responses)",
+           labels=("model",)),
+    Metric("tokens_generated_total", COUNTER,
+           "completion tokens emitted, by model (from the engines' own "
+           "usage counts)",
+           labels=("model",)),
     # -- multi-model serving (serving/registry.py; docs/MULTIMODEL.md) -----
     Metric("models_loaded", GAUGE,
            "models served by this process (manifest rows, or 1)"),
@@ -201,6 +228,60 @@ METRICS: dict[str, Metric] = _register(
            "admission_inflight, spec_*, lane_prefix_* / radix_prefix_*)",
            prefix=True),
 )
+
+
+@dataclasses.dataclass(frozen=True)
+class MemComponent:
+    """One registered memory-ledger component (obs/memledger.py): a
+    device-allocation surface that reports live byte counts into the
+    ``hbm_bytes{component,model}`` family.  ``device=False`` marks a
+    host-RAM tier (listed, but excluded from the HBM reconciliation
+    sum).  ``always=True`` keeps the row at ZERO instead of dropping it
+    — for gauges whose zero IS the alert condition (a fully exhausted
+    free list must read 0, not "no data").  Mirrors :class:`Metric`:
+    every ``MemLedger.register_component`` name must appear here —
+    enforced at runtime (KeyError) and statically (lfkt-lint OBS003)."""
+
+    name: str
+    help: str = ""
+    device: bool = True
+    always: bool = False
+
+
+#: THE memory-component catalog: every allocation surface the ledger may
+#: attribute.  ``residual`` is computed (ground truth minus the sum of
+#: device components), never registered.
+MEM_COMPONENTS: dict[str, MemComponent] = {
+    c.name: c for c in (
+        MemComponent("weights",
+                     "per-model resident weight bytes (Engine.weight_bytes"
+                     " — the registry's HBM budget unit)"),
+        MemComponent("kv_ring",
+                     "serial dense KV ring (Engine._cache; allocated on "
+                     "every engine, serving or not)"),
+        MemComponent("kv_lanes",
+                     "batched lane state: the mesh/continuous engines' "
+                     "shared decode pytree (parallel/batched.py)"),
+        MemComponent("kv_scratch",
+                     "the continuous scheduler's persistent prefill "
+                     "scratch ring (engine/continuous.py)"),
+        MemComponent("kv_arena_used",
+                     "KV pool arena pages holding indexed cache content, "
+                     "per radix namespace (model); model=(unindexed) is "
+                     "allocated-but-uncommitted in-flight pages"),
+        MemComponent("kv_arena_free",
+                     "KV pool arena pages on the free list (allocated "
+                     "HBM, no content); reported even at 0 — exhaustion "
+                     "is the alert", always=True),
+        MemComponent("host_spill",
+                     "host-RAM KV spill tier (LFKT_KV_SPILL_PAGES)",
+                     device=False),
+        MemComponent("residual",
+                     "ground truth minus every attributed device "
+                     "component: bytes the ledger cannot explain "
+                     "(computed, never registered)"),
+    )
+}
 
 
 def lookup(name: str) -> Metric | None:

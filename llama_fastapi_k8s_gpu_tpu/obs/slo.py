@@ -46,6 +46,7 @@ import threading
 import time
 from collections import deque
 
+from . import flightrec as _flightrec
 from .catalog import HISTOGRAM, lookup
 from .devtime import DEVTIME
 
@@ -130,8 +131,12 @@ class SLOEngine:
     """Burn-rate evaluator bound to one Metrics registry (per app)."""
 
     # snapshots are appended by whichever thread scrapes/evaluates;
-    # /debug/slo may race a /metrics render (lfkt-lint LOCK001)
+    # /debug/slo may race a /metrics render (lfkt-lint LOCK001).  The
+    # breach-episode latch is a single bool shared with the short-lived
+    # incident-record worker; a racing rollback costs at most one extra
+    # record attempt, which the recorder's per-kind debounce absorbs.
     _GUARDED_BY = {"_snaps": "_lock"}
+    _SHARED_ATOMIC = ("_breach_recorded",)
 
     def __init__(self, metrics, windows=None, thresholds: dict | None = None,
                  devtime=None):
@@ -151,6 +156,11 @@ class SLOEngine:
                 self.thresholds[slo.name] = float(knob(slo.threshold_knob))
         self._lock = threading.Lock()
         self._snaps: deque[tuple[float, dict]] = deque(maxlen=MAX_SNAPSHOTS)
+        #: breach-episode edge detector for the flight recorder: True
+        #: while the current breach has already been bundled (worst case
+        #: under racing evaluators: one extra bundle, caught by the
+        #: recorder's own per-kind debounce)
+        self._breach_recorded = False
         #: minimum spacing between RETAINED snapshots: without it, a 1 Hz
         #: /debug/slo poller fills the deque in ~17 min and silently
         #: truncates the long window's baseline while the gauge label
@@ -296,9 +306,40 @@ class SLOEngine:
         overall = ["ok", "warn", "breach"][worst_rank]
         if storms and overall == "ok":
             overall = "warn"        # perf incident with green latency SLOs
-        return {"now": now,
-                "windows": [self._window_label(w) for w in self.windows],
-                "slos": slos, "recompile": recompile, "verdict": overall}
+        doc = {"now": now,
+               "windows": [self._window_label(w) for w in self.windows],
+               "slos": slos, "recompile": recompile, "verdict": overall}
+        if overall == "breach":
+            # flight recorder (obs/flightrec.py): a confirmed breach is an
+            # incident — bundle the verdict with the process state while
+            # the burn is live.  Recorded on the RISING EDGE only (one
+            # bundle per breach episode): a breach persists across every
+            # scrape, and re-recording each debounce window would flood
+            # the bounded ring and prune the rare trip/OOM bundles the
+            # recorder exists to preserve.  The capture+write (ledger
+            # snapshot, trace serialization, fsync) runs on a short-lived
+            # worker thread: evaluate() is called from the async /metrics
+            # and /debug/slo handlers, and a multi-ms disk write must not
+            # stall the event loop of an already-degraded pod.  The latch
+            # is optimistic and ROLLED BACK by the worker when the record
+            # failed (disk full) or was debounced, so a later scrape
+            # retries instead of leaving the episode evidence-less.
+            if _flightrec.FLIGHTREC.armed and not self._breach_recorded:
+                self._breach_recorded = True
+                breached = [s["name"] for s in slos
+                            if s["verdict"] == "breach"]
+
+                def _record(doc=doc, names=tuple(breached)):
+                    if _flightrec.record_incident(
+                            "slo_breach",
+                            "SLO breach: " + ", ".join(names),
+                            extra={"slo": doc}) is None:
+                        self._breach_recorded = False
+                threading.Thread(target=_record, name="lfkt-slo-incident",
+                                 daemon=True).start()
+        else:
+            self._breach_recorded = False    # episode over: re-arm
+        return doc
 
     def export(self, now: float | None = None) -> dict:
         """Evaluate and publish ``slo_burn_rate{slo,window}`` gauges into

@@ -376,6 +376,25 @@ def annotate_all_inflight(name: str, **attrs) -> None:
         t.annotate_inflight(name, **attrs)
 
 
+def all_inflight_trees(limit: int = 32) -> list[dict]:
+    """Full span trees of every in-flight trace across EVERY live tracer
+    — the incident flight recorder's trace capture (obs/flightrec.py):
+    the request a watchdog trip interrupted must ride the bundle even
+    when the server was handed a private tracer.  Bounded: a bundle is a
+    post-mortem, not a dump."""
+    with _REGISTRY_LOCK:
+        tracers = list(_TRACERS)
+    out: list[dict] = []
+    for t in tracers:
+        with t._lock:
+            traces = list(t._inflight.values())
+        for tr in traces:
+            out.append(tr.to_dict())
+            if len(out) >= limit:
+                return out
+    return out
+
+
 #: process-wide default tracer the serving stack shares: the server starts
 #: traces on it (unless create_app was handed a private instance), engines
 #: attach spans to the handed-down Trace objects, and the watchdog/health/

@@ -44,6 +44,18 @@ def init_batched_state(cfg: ModelConfig, batch: int, seed: int = 0) -> dict:
     }
 
 
+def state_nbytes(state: dict | None) -> int:
+    """Resident HBM bytes of a batched generation state (cache lanes +
+    decode bookkeeping) — the memory ledger's ``kv_lanes`` row
+    (obs/memledger.py).  One reduction for the whole ledger: this is
+    ``tree_nbytes`` under the name that documents WHAT is being measured
+    (``.nbytes`` is shape metadata, safe even while the donating chunk
+    jits below hold the buffers in flight)."""
+    from ..obs.memledger import tree_nbytes
+
+    return tree_nbytes(state)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("caches",))
 def batched_prefill_jit(params, cfg: ModelConfig, tokens, lengths, caches):
     """tokens (B, S) padded; lengths (B,). Returns (logits (B, V), caches)."""

@@ -75,6 +75,7 @@ import numpy as np
 from ..models.config import ModelConfig
 from ..models.llama import init_cache
 from ..obs.devtime import timed_jit
+from ..obs.memledger import register_component
 
 logger = logging.getLogger(__name__)
 
@@ -183,17 +184,21 @@ class _Node:
     node is spilled — ``host`` then holds the page pytree on host RAM).
     Children are keyed by their edge's FIRST page tuple, so two sequences
     diverging mid-page land under different keys (pages are the sharing
-    unit: a partially shared page cannot be shared)."""
+    unit: a partially shared page cannot be shared).  ``ns`` is the radix
+    namespace the node lives under — the memory ledger's per-model
+    attribution key (the tree itself never consults it)."""
 
-    __slots__ = ("edge", "pages", "host", "children", "parent", "stamp")
+    __slots__ = ("edge", "pages", "host", "children", "parent", "stamp",
+                 "ns")
 
-    def __init__(self, edge, pages, parent):
+    def __init__(self, edge, pages, parent, ns: str = ""):
         self.edge: list[tuple] = edge          # page token tuples
         self.pages: list[int] | None = pages   # arena ids | None (spilled)
         self.host = None                       # host pytree when spilled
         self.children: dict[tuple, _Node] = {}
         self.parent: _Node | None = parent
         self.stamp = 0                         # LRU clock value
+        self.ns = ns
 
 
 class _Lease:
@@ -229,6 +234,7 @@ class KVPool:
         "_spill_used": "_lock",
         "_busy": "_lock",
         "counters": "_lock",
+        "_ns_pages": "_lock",
     }
 
     def __init__(self, cfg: ModelConfig, page_tokens: int = 128,
@@ -271,6 +277,13 @@ class KVPool:
         #: one radix root per namespace (model) — prefixes never match
         #: across namespaces; the arena/free-list/LRU stay shared
         self._roots: dict[str, _Node] = {}
+        #: DEVICE-resident indexed pages per namespace, maintained
+        #: incrementally at the four mutation sites (commit / spill /
+        #: drop / spill-restore) so the memory ledger's per-model
+        #: attribution is O(namespaces) per scrape instead of a radix DFS
+        #: under the allocation lock (invariant pinned by test against a
+        #: fresh tree walk)
+        self._ns_pages: dict[str, int] = {}
         self._clock = 0
         self._spill_used = 0
         #: node ids an in-progress walk depends on — evict/age must skip
@@ -282,6 +295,13 @@ class KVPool:
             "stored_pages": 0, "evictions": 0, "spills": 0, "restores": 0,
             "store_skips": 0,
         }
+        # lfkt-mem: attribute the arena into the process memory ledger —
+        # indexed pages per namespace (model), the free list, and the
+        # host spill tier.  A shared multi-model pool registers ONCE
+        # (here, at construction), so the rows never double-count.
+        register_component("kv_arena_used", self, KVPool._ledger_used)
+        register_component("kv_arena_free", self, KVPool._ledger_free)
+        register_component("host_spill", self, KVPool._ledger_spill)
 
     @property
     def _root(self) -> _Node:
@@ -291,7 +311,7 @@ class KVPool:
         tests do); the dict setdefault is GIL-atomic."""
         root = self._roots.get("")
         if root is None:
-            root = self._roots.setdefault("", _Node([], [], None))  # lfkt: noqa[LOCK001] -- GIL-atomic setdefault (a losing racer's node is discarded); taking _lock here would deadlock the white-box callers that already hold it
+            root = self._roots.setdefault("", _Node([], [], None, ""))  # lfkt: noqa[LOCK001] -- GIL-atomic setdefault (a losing racer's node is discarded); taking _lock here would deadlock the white-box callers that already hold it
         return root
 
     # -- telemetry (never fails serving) -----------------------------------
@@ -432,7 +452,9 @@ class KVPool:
                 off += len(g)
         if span is not None:
             span.event("kv_restore", pages=len(lease.page_ids),
-                       tokens=lease.tokens, host_s=round(time.time() - t0, 6))
+                       tokens=lease.tokens,
+                       bytes=len(lease.page_ids) * self.page_nbytes,
+                       host_s=round(time.time() - t0, 6))
         return ring
 
     def commit(self, ids, ring: dict, span=None, *,
@@ -468,17 +490,79 @@ class KVPool:
             self._free = list(range(self.n_pages))
             self._page_refs = {}
             self._spill_used = 0
+            self._ns_pages = {}
             self._busy.clear()
 
-    def occupancy(self) -> dict:
-        """Point-in-time pool occupancy for /health and the
-        ``kv_pool_pages_{used,free}`` gauges."""
+    # -- memory-ledger providers (obs/memledger.py; called at snapshot
+    # time from scrape/incident threads) -----------------------------------
+    def _ledger_used(self) -> dict:
+        """Indexed device pages per namespace, in bytes — read from the
+        incrementally maintained ``_ns_pages`` counters, so a scrape
+        holds the allocation lock for O(namespaces), never a radix DFS
+        (the occupancy() no-stall rule; counter==tree invariant pinned by
+        test).  Pages allocated but not (yet) reachable from any tree —
+        an in-flight commit, or a store that failed before indexing —
+        land under ``(unindexed)`` so the arena's used+free always sums
+        to its full allocation."""
         with self._lock:
-            free = len(self._free)
+            per_ns = {ns: pages * self.page_nbytes
+                      for ns, pages in self._ns_pages.items() if pages}
+            inflight = (self.n_pages - len(self._free)) \
+                - sum(self._ns_pages.values())
+        if inflight > 0:
+            per_ns["(unindexed)"] = inflight * self.page_nbytes
+        return per_ns
+
+    def _ledger_used_slow(self) -> dict:
+        """The DFS ground truth ``_ledger_used`` must agree with — test
+        oracle only (holds the lock for a full tree walk)."""
+        with self._lock:
+            per_ns: dict[str, int] = {}
+            for ns, root in self._roots.items():
+                pages = 0
+                stack = list(root.children.values())
+                while stack:
+                    n = stack.pop()
+                    stack.extend(n.children.values())
+                    if n.pages is not None:
+                        pages += len(n.pages)
+                if pages:
+                    per_ns[ns] = pages * self.page_nbytes
+        return per_ns
+
+    def _ledger_free(self) -> int:
+        with self._lock:
+            return len(self._free) * self.page_nbytes
+
+    def _ledger_spill(self) -> int:
+        with self._lock:
+            return self._spill_used * self.page_nbytes
+
+    def occupancy(self) -> dict:
+        """Point-in-time pool occupancy for /health, the
+        ``kv_pool_pages_{used,free}`` gauges and /debug/memory's
+        fragmentation line (largest run of CONSECUTIVE free page ids vs
+        the free count: a fragmented arena can hold many pages but no
+        contiguous run — informational here, load-bearing once pages
+        stream as the disaggregated-prefill wire format)."""
+        with self._lock:
+            free_ids = list(self._free)
             pinned = len(self._page_refs)
             spill = self._spill_used
             namespaces = len(self._roots)
+        # the O(n log n) run scan happens OUTSIDE the lock (a /metrics
+        # scrape must never stall a decode-path allocation on it); the
+        # copied snapshot may be an instant stale, which is fine for an
+        # occupancy report
+        free = len(free_ids)
+        run = best = 0
+        prev = None
+        for pid in sorted(free_ids):
+            run = run + 1 if prev is not None and pid == prev + 1 else 1
+            best = max(best, run)
+            prev = pid
         return {
+            "largest_free_run": best,
             "page_tokens": self.page_tokens,
             "page_bytes": self.page_nbytes,
             "pages_total": self.n_pages,
@@ -506,7 +590,7 @@ class KVPool:
     def _root_for(self, ns: str) -> _Node:  # lfkt: holds[_lock]
         root = self._roots.get(ns)
         if root is None:
-            root = self._roots[ns] = _Node([], [], None)
+            root = self._roots[ns] = _Node([], [], None, ns)
         return root
 
     def _match(self, ids: list, ns: str = ""):  # lfkt: holds[_lock]
@@ -567,10 +651,12 @@ class KVPool:
         node.pages = pids
         node.host = None
         self._spill_used -= n
+        self._ns_pages[node.ns] = self._ns_pages.get(node.ns, 0) + n
         self.counters["restores"] += 1
         self._emit("inc", "prefix_cache_restores_total")
         if span is not None:
             span.event("kv_spill_restore", pages=n,
+                       bytes=n * self.page_nbytes,
                        host_s=round(time.time() - t0, 6))
         return True
 
@@ -637,9 +723,11 @@ class KVPool:
                 self.counters["store_skips"] += 1
                 logger.warning("page store failed; commit skipped: %s", e)
                 return 0
-            child = _Node(tail, pids, parent)
+            child = _Node(tail, pids, parent, namespace)
             child.stamp = self._clock
             parent.children[tail[0]] = child
+            self._ns_pages[namespace] = \
+                self._ns_pages.get(namespace, 0) + len(tail)
             self.counters["stored_pages"] += len(tail)
             return len(tail)
 
@@ -649,7 +737,7 @@ class KVPool:
         construction (children are keyed by their first page)."""
         upper = _Node(node.edge[:at],
                       node.pages[:at] if node.pages is not None else None,
-                      node.parent)
+                      node.parent, node.ns)
         upper.stamp = node.stamp
         if node.pages is None:
             # spilled: split the host page stacks along the page axis
@@ -742,12 +830,17 @@ class KVPool:
                 self._emit("inc", "prefix_cache_spills_total")
                 if span is not None:
                     span.event("kv_spill", pages=n,
+                               bytes=n * self.page_nbytes,
                                host_s=round(time.time() - t0, 6))
                 self._free.extend(victim.pages)
                 victim.pages = None
+                self._ns_pages[victim.ns] = max(
+                    0, self._ns_pages.get(victim.ns, 0) - n)
             elif not victim.children:
                 self._free.extend(victim.pages)
                 victim.pages = None
+                self._ns_pages[victim.ns] = max(
+                    0, self._ns_pages.get(victim.ns, 0) - n)
                 self._unlink(victim)
             else:
                 continue        # interior, no spill room: try the next LRU

@@ -41,6 +41,8 @@ from .asgikit import (
 
 import uuid
 
+from ..obs import flightrec as _flightrec
+from ..obs import memledger as _memledger
 from ..obs.devtime import DEVTIME
 from ..obs.logctx import access_logger, bind_request_id
 from ..obs.slo import SLOEngine
@@ -336,6 +338,15 @@ def create_app(engine=None, settings: Settings | None = None,
                 m.inc("prefix_cache_hits_total")
                 m.inc("prefix_cache_reused_tokens_total", reused)
 
+    def _meter_tokens(m, prompt: int, completion: int, model: str) -> None:
+        """Per-model token metering (tokens_prompt_total /
+        tokens_generated_total): multi-tenant billing from the engines'
+        own usage counts, so nobody has to scrape /v1 response bodies."""
+        if prompt:
+            m.inc("tokens_prompt_total", prompt, model=model)
+        if completion:
+            m.inc("tokens_generated_total", completion, model=model)
+
     def _answer_to_text(answer, m) -> str:
         """OpenAI-shaped dict → concatenated choice text (reference
         api.py:65-74 semantics, incl. the dict typecheck → 500)."""
@@ -347,6 +358,9 @@ def create_app(engine=None, settings: Settings | None = None,
         usage = answer.get("usage") or {}
         if usage.get("completion_tokens"):
             m.inc("generated_tokens_total", usage["completion_tokens"])
+        _meter_tokens(m, usage.get("prompt_tokens", 0),
+                      usage.get("completion_tokens", 0),
+                      _model_label(answer))
         return "".join(c["message"]["content"]
                        for c in answer.get("choices", []) if "message" in c)
 
@@ -362,6 +376,9 @@ def create_app(engine=None, settings: Settings | None = None,
         usage = answer.get("usage") or {}
         if usage.get("completion_tokens"):
             m.inc("generated_tokens_total", usage["completion_tokens"])
+        _meter_tokens(m, usage.get("prompt_tokens", 0),
+                      usage.get("completion_tokens", 0),
+                      _model_label(answer))
         answer = dict(answer)
         answer.pop("lfkt_timings", None)
         return answer
@@ -706,6 +723,13 @@ def create_app(engine=None, settings: Settings | None = None,
             m.inc("streamed_generations_total")
             _observe_engine_timings(
                 m, {"lfkt_timings": timings_box[0]} if timings_box else None)
+            if timings_box:
+                # streamed responses never pass through _answer_to_text:
+                # meter them from the engine's own timings rider
+                t = timings_box[0]
+                _meter_tokens(m, t.get("prompt_tokens", 0),
+                              t.get("completion_tokens", 0),
+                              _model_label(t))
 
         if semaphore is None:
             await _go()
@@ -756,6 +780,10 @@ def create_app(engine=None, settings: Settings | None = None,
         # injection, not an import, so library/bench engines stay free
         if hasattr(engine, "metrics_sink"):
             engine.metrics_sink = app.state.metrics
+        # hand the flight recorder the process context its bundles carry
+        # (weakly held; obs/flightrec.py) — a later app wins, which is
+        # exactly the live serving app
+        _flightrec.FLIGHTREC.install(health=app.state.health, engine=engine)
         app.state.ready = True
         app.state.health.transition(READY, "engine loaded")
         if settings.watchdog and getattr(engine, "heartbeat", None) is None \
@@ -1258,6 +1286,31 @@ def create_app(engine=None, settings: Settings | None = None,
                             snap["adm_budget_tokens"])
             if "lane_idle_seconds" in snap:
                 m.set_gauge("lane_idle_seconds", snap["lane_idle_seconds"])
+        # lfkt-mem: live HBM attribution gauges (obs/memledger.py) — one
+        # series per (component, model), residual = ground truth minus the
+        # attributed sum, headroom only where the backend reports limits.
+        # The families are rebuilt WHOLE from the ledger each scrape: a
+        # vanished row (drained spill tier, collected engine) must drop
+        # its series, not freeze at its last value.  The reset→rebuild→
+        # render sequence is atomic because this handler has NO await
+        # between here and render() (one event loop, LFKT_WORKERS=1) —
+        # inserting an await in between would let a concurrent scrape
+        # render the family half-built
+        m.reset_family("hbm_bytes")
+        m.reset_family("hbm_headroom_bytes")
+        if _memledger.MEMLEDGER.armed:
+            mdoc = _memledger.MEMLEDGER.snapshot()
+            for row in mdoc["components"]:
+                m.set_gauge("hbm_bytes", row["bytes"],
+                            component=row["component"], model=row["model"])
+            if mdoc["residual_bytes"] is not None:
+                m.set_gauge("hbm_bytes", mdoc["residual_bytes"],
+                            component="residual", model="")
+            if mdoc["headroom"] is not None:
+                m.set_gauge("hbm_headroom_bytes", mdoc["headroom"]["bytes"])
+        if _flightrec.FLIGHTREC.armed:
+            m.set_gauge("incidents_total",
+                        _flightrec.FLIGHTREC.recorded_total)
         tstats = app.state.tracer.stats()
         m.set_gauge("trace_ring_used", tstats["ring_used"])
         m.set_gauge("traces_started_total", tstats["started_total"])
@@ -1320,6 +1373,57 @@ def create_app(engine=None, settings: Settings | None = None,
         recompile-storm state.  ``verdict`` is the pod's one-word answer:
         ok | warn | breach."""
         return app.state.slo.evaluate()
+
+    @app.get("/debug/memory")
+    async def debug_memory():
+        """The live HBM memory ledger (obs/memledger.py): per-component
+        attribution with a residual line reconciled against device ground
+        truth, headroom, and — when the paged KV pool serves — arena
+        fragmentation (largest contiguous free run vs free pages).  The
+        "where did my HBM go" answer (docs/RUNBOOK.md 'Diagnosing HBM
+        OOM')."""
+        doc = _memledger.MEMLEDGER.snapshot()
+        occ = getattr(app.state.engine, "kv_pool_occupancy", None)
+        pool = occ() if callable(occ) else None
+        if pool is not None and doc.get("armed"):
+            free = pool.get("pages_free")
+            run = pool.get("largest_free_run")
+            doc["kv_pool"] = pool
+            if free and run is not None:
+                doc["fragmentation"] = {
+                    "pages_free": free,
+                    "largest_free_run": run,
+                    # 0 = one contiguous run; →1 = maximally shattered
+                    "ratio": round(1.0 - run / free, 4),
+                }
+        return doc
+
+    @app.get("/debug/incidents")
+    async def debug_incidents():
+        """The incident flight recorder's on-disk ring (obs/flightrec.py):
+        bundle summaries, newest first.  Empty (armed: false) until
+        LFKT_INCIDENT_DIR is set."""
+        fr = _flightrec.FLIGHTREC
+        # bundle summaries come off DISK (full-ring reads, potentially
+        # MBs of traces): a worker thread, never the event loop — this
+        # endpoint gets hit exactly when the pod is already degraded
+        incidents = await asyncio.to_thread(fr.list) if fr.armed else []
+        return {"armed": fr.armed,
+                "recorded_total": fr.recorded_total,
+                "debounced_total": fr.debounced_total,
+                "incidents": incidents}
+
+    @app.get("/debug/incidents/{incident_id}")
+    async def debug_incident(incident_id: str):
+        """One full incident bundle read back from disk: memory ledger,
+        in-flight traces at capture time, scheduler stats, health
+        transitions, recompile-storm state, log tail."""
+        doc = await asyncio.to_thread(_flightrec.FLIGHTREC.get, incident_id)
+        if doc is None:
+            raise HTTPException(
+                status_code=404,
+                detail=f"no incident {incident_id!r} in the ring")
+        return doc
 
     @app.get("/debug/profile")
     async def debug_profile(request: Request):

@@ -35,7 +35,9 @@ submit paths.
 from __future__ import annotations
 
 import logging
+import os
 
+from ..obs import memledger as _memledger
 from .manifest import ModelSpec
 
 logger = logging.getLogger(__name__)
@@ -162,6 +164,19 @@ class ModelRegistry:
         used = 0
         for spec in specs:
             path = spec.resolved_path(model_dir)
+            # lfkt-mem pre-load fit check: before a multi-GB load even
+            # starts, ask the memory ledger whether the device can hold
+            # it (file size lower-bounds the resident weight bytes; the
+            # serving layout is never smaller than the quantized file).
+            # Where the backend reports no memory_stats (CPU) this is a
+            # no-op and the weight BUDGET below stays the only gate.
+            try:
+                est = os.path.getsize(path)
+            except OSError:
+                est = 0             # missing file: let build() name it
+            refusal = _memledger.MEMLEDGER.fit_check(est, label=spec.name)
+            if refusal is not None:
+                raise WeightBudgetError(refusal)
             eng = build(spec, path, shared_pool)
             # responses, traces, /debug/requests rows and metric labels
             # all read model_name — the manifest alias IS the serving
@@ -287,7 +302,9 @@ class ModelRegistry:
     #: per-pool descriptive (NON-additive) occupancy fields: summing
     #: them across heterogeneous pools would report nonsense geometry —
     #: the merged document lists them per pool instead
-    _POOL_DESCRIPTIVE = ("page_tokens", "page_bytes")
+    #: (largest_free_run is a within-arena contiguity fact: runs do not
+    #: concatenate across arenas)
+    _POOL_DESCRIPTIVE = ("page_tokens", "page_bytes", "largest_free_run")
 
     def kv_pool_occupancy(self) -> dict | None:
         """Merged pool occupancy + counters for /health and the

@@ -353,6 +353,29 @@ KNOBS: dict[str, Knob] = _register(
     Knob("LFKT_SLO_WINDOWS", str,
          "SLO burn-rate windows, csv seconds (short,long)",
          serving=True, default="300,3600"),
+    # -- lfkt-mem (obs/memledger.py + obs/flightrec.py; docs/RUNBOOK.md
+    # "Diagnosing HBM OOM") -------------------------------------------------
+    Knob("LFKT_MEM_LEDGER", bool,
+         "live HBM memory ledger: component attribution + /debug/memory "
+         "+ hbm_bytes gauges (0 disarms; obs/memledger.py)",
+         serving=True, default=True),
+    Knob("LFKT_MEM_PRESSURE_FRACTION", float,
+         "device HBM headroom fraction below which the admission "
+         "controller treats memory as pressure and cuts its budget",
+         serving=True, default=0.05),
+    Knob("LFKT_INCIDENT_DIR", str,
+         "incident flight-recorder directory (empty = recorder off; "
+         "mount a pod volume so bundles survive restarts)",
+         serving=True, default=""),
+    Knob("LFKT_INCIDENT_RING", int,
+         "max incident bundles kept on disk (oldest pruned)",
+         serving=True, default=16),
+    Knob("LFKT_INCIDENT_DEBOUNCE_S", float,
+         "per-kind minimum seconds between incident bundles (a burst "
+         "records once, not once per error)", default=30.0),
+    Knob("LFKT_INCIDENT_LOG_LINES", int,
+         "structured log lines retained for a bundle's log_tail",
+         default=100),
     # -- lfkt-obs (obs/trace.py; docs/OBSERVABILITY.md) --------------------
     Knob("LFKT_TRACE_SAMPLE", float,
          "fraction of requests traced (0 disarms the tracer)",

@@ -28,6 +28,7 @@ import threading
 import time
 from collections import deque
 
+from ..obs import flightrec as _flightrec
 from ..obs.trace import annotate_all_inflight
 
 # -- states (string constants: JSON-friendly, no enum dependency) ----------
@@ -104,10 +105,20 @@ class Heartbeat:
             self._busy = max(0, int(n))
 
     def record_error(self, exc: BaseException) -> None:
+        msg = f"{type(exc).__name__}: {exc}"
         with self._lock:
             self._errors.append(time.monotonic())
             self.errors_total += 1
-            self.last_error = f"{type(exc).__name__}: {exc}"
+            self.last_error = msg
+        # device OOM is THE incident the flight recorder exists for: by
+        # the time the watchdog trips on the burst, the allocation state
+        # that caused it is gone — bundle it at first sight.  Outside the
+        # lock; disarmed this is one attribute read inside record(), and
+        # the per-kind debounce keeps an OOM burst at one bundle.
+        if _flightrec.OOM_SIGNATURE in msg:
+            _flightrec.record_incident(
+                "resource_exhausted", msg,
+                extra={"errors_total": self.errors_total})
 
     def clear_errors(self) -> None:
         """Consume the burst evidence (watchdog trip handled): a re-trip

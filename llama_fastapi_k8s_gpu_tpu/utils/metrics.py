@@ -134,6 +134,22 @@ class Metrics:
             s.total += float(value)
             s.count += 1
 
+    def reset_family(self, name: str) -> None:
+        """Drop every series of a snapshot-style GAUGE family before a
+        re-export.  Families rebuilt whole from a registry at scrape time
+        (the memory ledger's ``hbm_bytes`` rows) must forget series whose
+        source row vanished — a drained spill tier or a collected
+        engine's rows would otherwise report their last value forever.
+        Counters/histograms are cumulative by contract and must never be
+        reset this way."""
+        metric = lookup(name)
+        if metric is None or metric.mtype != GAUGE:
+            raise KeyError(
+                f"reset_family is for cataloged gauge families; "
+                f"{name!r} is not one")
+        with self._lock:
+            self._series.pop(name, None)
+
     # -- programmatic reads (obs/slo.py burn-rate evaluation) ------------
     def snapshot(self) -> dict:
         """Point-in-time copy of every series' raw storage:

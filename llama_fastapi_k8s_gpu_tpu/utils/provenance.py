@@ -77,9 +77,39 @@ def knob_fingerprint() -> dict:
     return {"knobs": knobs, "knob_hash": digest}
 
 
+def mem_stats() -> dict:
+    """The stamp's memory axis: process peak RSS and (where the backend
+    reports memory_stats) device peak HBM bytes.  NOT memoized — peaks
+    only grow, and each emitted line should carry the peak as of ITS
+    measurement.  Best-effort like everything here: a field that cannot
+    be read is omitted, never faked."""
+    out: dict = {}
+    try:
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KB on Linux, bytes on macOS
+        out["rss_peak_bytes"] = int(ru) * (1 if sys.platform == "darwin"
+                                           else 1024)
+    except Exception:  # noqa: BLE001 — metadata must never fail a bench
+        pass
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            out["device_peak_bytes"] = int(peak)
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
 def stamp(cwd: str | None = None) -> dict:
     """The full provenance block for one bench JSON line."""
     return {"schema": SCHEMA,
             "git_commit": _git_commit(cwd),
             "device": _device_kind(),
+            "mem": mem_stats(),
             **knob_fingerprint()}

@@ -18,3 +18,17 @@ METRICS = {
                prefix=True),
     ]
 }
+
+
+class MemComponent:
+    def __init__(self, name, help="", device=True):
+        self.name = name
+        self.help = help
+        self.device = device
+
+
+MEM_COMPONENTS = {
+    c.name: c for c in [
+        MemComponent("known_component", "registered ledger surface"),
+    ]
+}

@@ -25,3 +25,21 @@ def record():
 
 def suppressed_record():
     m.inc("audited_total")  # lfkt: noqa[OBS001] -- fixture: proves suppression works
+
+
+class _Ledger:
+    def register_component(self, name, owner, provider):
+        pass
+
+
+ledger = _Ledger()
+
+
+def register_surfaces():
+    ledger.register_component("known_component", m, len)     # fine: cataloged
+    ledger.register_component("phantom_component", m, len)   # OBS003
+    ledger.register_component(f"dyn_{m}", m, len)            # fine: dynamic
+
+
+def suppressed_surface():
+    ledger.register_component("audited_component", m, len)  # lfkt: noqa[OBS003] -- fixture: proves suppression works

@@ -320,6 +320,18 @@ def test_provenance_stamp_schema(monkeypatch):
                    "provenance": broken})
     assert any("git_commit" in e for e in errs)
     assert any("knobs" in e for e in errs)
+    # the memory axis (ISSUE 10): every stamp carries mem.rss_peak_bytes
+    # (device_peak_bytes only where the backend reports memory_stats),
+    # the peaks only grow, and check_manifest validates the block
+    assert s1["mem"]["rss_peak_bytes"] > 0
+    assert provenance.stamp()["mem"]["rss_peak_bytes"] >= \
+        s1["mem"]["rss_peak_bytes"]
+    errs = cm.validate_schema(
+        "x.json", {"metric": "m[t]", "value": 1.0, "unit": "ms",
+                   "provenance": dict(s1, mem={"rss_peak_bytes": -3,
+                                               "bogus_field": 1})})
+    assert any("rss_peak_bytes" in e for e in errs)
+    assert any("bogus_field" in str(e) for e in errs)
 
 
 def test_bench_emit_result_stamps_provenance(tmp_path):

@@ -103,6 +103,7 @@ def _fired(rule, path_part, suppressed=False):
     ("CFG004", "helm/deployment.yaml", 1),  # phantom probe path
     ("OBS001", "obsbad.py", 2),     # typo'd inc + phantom observe
     ("OBS002", "obs/catalog.py", 1),    # undocumented cataloged metric
+    ("OBS003", "obsbad.py", 1),     # phantom memledger component
     ("KER001", "kernbad.py", 1),    # pallas_call without interpret=
     ("KER002", "kernbad.py", 1),    # no probe, no fallback
     ("KER003", "kernbad.py", 1),    # call inside a block shape
@@ -146,6 +147,7 @@ def test_host_only_code_not_flagged_by_jit_rules():
     ("CFG001", "cfgbad.py"),        # suppressed_read
     ("JIT001", "jitbad.py"),        # def-line noqa covers the body
     ("OBS001", "obsbad.py"),        # audited_total suppression
+    ("OBS003", "obsbad.py"),        # audited_component suppression
     ("PERF001", "perfbad.py"),      # suppressed_builder's audited noqa
     ("RES001", "resbad.py"),        # suppressed_leak's audited noqa
     ("DON001", "donbad.py"),        # suppressed_read's audited noqa
@@ -374,7 +376,7 @@ def test_ci_gate_aggregates_lint_and_manifest():
     doc = json.loads(proc.stdout)
     assert doc["ok"] is True
     names = {c["name"] for c in doc["checks"]}
-    assert names == {"lfkt-lint", "check-manifest"}
+    assert names == {"lfkt-lint", "check-manifest", "incident-schema"}
     assert all(c["exit"] == 0 for c in doc["checks"])
 
 

@@ -59,6 +59,20 @@ def validate_schema(name: str, doc) -> list[str]:
                 and isinstance(v, str) for k, v in knobs.items()):
             bad.append(f"{name}: provenance 'knobs' must map LFKT_* names "
                        "to strings")
+        mem = prov.get("mem")
+        if mem is None:
+            continue          # pre-memory-axis corpus: block optional
+        if not isinstance(mem, dict):
+            bad.append(f"{name}: provenance 'mem' is not an object")
+            continue
+        for field in ("rss_peak_bytes", "device_peak_bytes"):
+            v = mem.get(field)
+            if v is not None and (not isinstance(v, int) or v < 0):
+                bad.append(f"{name}: provenance mem.{field} must be a "
+                           "non-negative integer")
+        if set(mem) - {"rss_peak_bytes", "device_peak_bytes"}:
+            bad.append(f"{name}: provenance 'mem' carries unknown fields "
+                       f"{sorted(set(mem) - {'rss_peak_bytes', 'device_peak_bytes'})}")
     return bad
 
 

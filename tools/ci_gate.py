@@ -30,6 +30,11 @@ CHECKS: list[tuple[str, list[str]]] = [
     ("lfkt-lint", [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.lint"]),
     ("check-manifest", [sys.executable,
                         os.path.join(ROOT, "tools", "check_manifest.py")]),
+    # any incident bundle present (in $LFKT_INCIDENT_DIR) must validate
+    # against the versioned flight-recorder schema; no dir = trivially OK
+    ("incident-schema", [sys.executable,
+                         os.path.join(ROOT, "tools", "incident_report.py"),
+                         "--validate"]),
 ]
 
 

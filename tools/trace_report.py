@@ -47,6 +47,17 @@ def _fmt_ms(seconds: float | None) -> str:
     return "     ?" if seconds is None else f"{seconds * 1000.0:6.1f}"
 
 
+def _fmt_bytes(b) -> str:
+    """Compact byte count for event suffixes (page moves, headroom)."""
+    if not isinstance(b, (int, float)):
+        return "?"
+    if b >= 1e9:
+        return f"{b / 1e9:.2f}GB"
+    if b >= 1e6:
+        return f"{b / 1e6:.1f}MB"
+    return f"{b / 1e3:.0f}KB"
+
+
 def render_trace(trace: dict) -> str:
     """One trace's ASCII waterfall + phase percentages.
 
@@ -115,15 +126,25 @@ def render_trace(trace: dict) -> str:
             if ev["name"] in ("kv_restore", "kv_spill", "kv_spill_restore") \
                     and host_s is not None:
                 # paged-KV page movement (░, parallel/kvpool.py): the
-                # copy/DMA cost in the same waterfall as the prefill
-                # slices it delays
-                duration_bar(at, host_s, "░", ev["name"],
-                             f"pages={ev.get('pages', '?')}")
+                # copy/DMA cost — with its byte count — in the same
+                # waterfall as the prefill slices it delays
+                suffix = f"pages={ev.get('pages', '?')}"
+                if ev.get("bytes") is not None:
+                    suffix += f" {_fmt_bytes(ev['bytes'])}"
+                duration_bar(at, host_s, "░", ev["name"], suffix)
                 continue
             mark = min(int(at / total * WIDTH), WIDTH - 1)
             tick = " " * mark + "▲" + " " * (WIDTH - mark - 1)
             ename = (" " * ((depth + 1) * INDENT) + "* " + ev["name"])[:NAME_COL]
-            lines.append(f"{ename:<{NAME_COL}} {_fmt_ms(at)} {'':>6} |{tick}|")
+            suffix = ""
+            if ev["name"] == "mem_pressure":
+                # lfkt-mem: the admission controller cut its budget on
+                # low HBM headroom — the byte counts explain the slower
+                # admissions that follow in this waterfall
+                suffix = (f"  headroom={_fmt_bytes(ev.get('headroom_bytes'))}"
+                          f"/{_fmt_bytes(ev.get('limit_bytes'))}")
+            lines.append(
+                f"{ename:<{NAME_COL}} {_fmt_ms(at)} {'':>6} |{tick}|{suffix}")
 
     if phase_seconds:
         lines.append("")
