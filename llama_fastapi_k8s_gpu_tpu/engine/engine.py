@@ -157,6 +157,13 @@ class Engine:
     #: which must be unsharded — engine/sp.py overrides to False.
     _KV_PAGED = True
 
+    #: whether this engine can arm layer-looped decode
+    #: (LFKT_DECODE_LAYER_UNROLL, ops/pallas/decode_loop.py): the
+    #: sp-sharded ring's attention crosses chips per layer, which one
+    #: fused kernel cannot — engine/sp.py overrides to False and the
+    #: knob degrades with attribution.
+    _DECODE_LOOP = True
+
     def __init__(
         self,
         model_path: str | None,
@@ -186,6 +193,11 @@ class Engine:
         kv_page_tokens: int = 128,  # token slots per pool page
         kv_pool_pages: int = 0,     # pool size in pages (0 = auto)
         kv_spill_pages: int = 0,    # host-RAM spill tier capacity (0 = off)
+        decode_layer_unroll: int | None = None,  # layers fused per decode
+        #                             launch (ops/pallas/decode_loop.py):
+        #                             0 = per-layer chain, -1 = all layers
+        #                             in ONE launch, K = K per launch;
+        #                             None reads LFKT_DECODE_LAYER_UNROLL
         *,
         kv_pool=None,               # adopt a shared KVPool (multi-model
         #                             registry, docs/MULTIMODEL.md) instead
@@ -347,6 +359,67 @@ class Engine:
                 attn_impl = "xla"
         if attn_impl != self.cfg.attn_impl:
             self.cfg = dataclasses.replace(self.cfg, attn_impl=attn_impl)
+        # -- layer-looped decode (ROADMAP item 2; ops/pallas/decode_loop.py)
+        # Resolve the knob, validate the weight plan, and compile-probe the
+        # looped kernel at THIS engine's ring geometry NOW: every refusal
+        # degrades to the per-layer path with attribution (the degrade
+        # ledger at /debug/compiles) instead of crash-looping warmup, and
+        # warmup then compiles whichever decode program was chosen.
+        if decode_layer_unroll is None:
+            from ..utils.config import knob
+            decode_layer_unroll = int(knob("LFKT_DECODE_LAYER_UNROLL"))
+        decode_layer_unroll = int(decode_layer_unroll)
+        if decode_layer_unroll < -1:
+            raise ValueError(
+                f"decode_layer_unroll must be >= -1 (0 = off, -1 = all "
+                f"layers per launch), got {decode_layer_unroll}")
+        if decode_layer_unroll:
+            from ..obs.devtime import DEVTIME
+            if not self._DECODE_LOOP:
+                msg = (f"{type(self).__name__} serves ring attention "
+                       "(sp-sharded KV): layer-looped decode gates off — "
+                       "serving per-layer decode")
+                logger.warning(msg)
+                DEVTIME.record_degrade("decode_loop", msg)
+                decode_layer_unroll = 0
+        if decode_layer_unroll:
+            from ..models.params import decode_loop_plan
+            from ..ops.pallas.probe import probe_decode_loop
+
+            fmts, reason = decode_loop_plan(self.params, self.cfg)
+            if reason is not None:
+                logger.warning("layer-looped decode unavailable (%s); "
+                               "serving per-layer decode", reason)
+                DEVTIME.record_degrade("decode_loop", reason)
+                decode_layer_unroll = 0
+            else:
+                err = probe_decode_loop(
+                    quantized=self.cfg.kv_dtype == "int8",
+                    int8_weights=fmts["wq"] == "int8",
+                    n_kv=self.cfg.n_kv_heads, head_dim=self.cfg.head_dim,
+                    n_ctx=self.cfg.n_ctx,
+                    sliding_window=self.cfg.sliding_window,
+                    n_heads=self.cfg.n_heads, ffn_dim=self.cfg.ffn_dim)
+                if err is not None:
+                    # pin the per-layer path for THIS kernel geometry,
+                    # process-wide: direct forward() callers must not
+                    # re-arm a lowering that already failed here, while
+                    # a co-resident registry model with a different
+                    # geometry (its own probe verdict) keeps looping
+                    from ..ops.pallas.decode_loop import (
+                        disable_decode_loop,
+                        loop_geometry,
+                    )
+
+                    disable_decode_loop(err, loop_geometry(self.cfg, fmts))
+                    logger.error(
+                        "layer-looped decode kernel failed its compile "
+                        "probe; serving per-layer decode: %s", err)
+                    DEVTIME.record_degrade("decode_loop", err)
+                    decode_layer_unroll = 0
+        if decode_layer_unroll != self.cfg.decode_layer_unroll:
+            self.cfg = dataclasses.replace(
+                self.cfg, decode_layer_unroll=decode_layer_unroll)
         if self._spec_request == "auto":
             from .spec_auto import resolve_auto
 

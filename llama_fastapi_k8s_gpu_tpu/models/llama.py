@@ -89,6 +89,56 @@ def cache_nbytes(cfg: ModelConfig) -> int:
     return 2 * cfg.n_layers * cfg.n_kv_heads * cfg.n_ctx * per_tok_head
 
 
+def xla_attention(q, kk, vv, cks, cvs, positions, cfg: ModelConfig,
+                  out_dtype):
+    """The XLA score-matrix attention over a full head-major ring — the
+    decode path (S=1 always lands here) and the small-prompt prefill path.
+
+    Extracted from :func:`_layer` so the layer-looped decode kernel
+    (ops/pallas/decode_loop.py) runs the SAME code: bit-exactness of the
+    looped path is then a property of shared source, not of two
+    implementations agreeing.  ``cks``/``cvs`` are the int8 cache's
+    per-head per-token scales (None for bf16): scores are linear in K and
+    probs·V is linear in V, so both scale sets fold OUTSIDE the int8
+    contractions and no dequantized ring is ever materialized."""
+    S = q.shape[0]
+    n_kv, group, hd = cfg.n_kv_heads, cfg.n_heads // cfg.n_kv_heads, cfg.head_dim
+    quant = cks is not None
+    # (S, n_kv, group, hd) → (n_kv, group, S, hd)
+    qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
+    if quant:
+        # scores are linear in K, so the per-token scale factors out of
+        # the contraction: einsum over the RAW int8 ring (the int8→bf16
+        # convert fuses into the dot's operand read — HBM moves int8),
+        # then scale each key column once.  No dequantized ring is ever
+        # materialized.
+        scores = jnp.einsum(
+            "ngsh,nch->ngsc", qg, kk.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        ) * (hd ** -0.5) * cks[:, None, None, :]
+    else:
+        scores = jnp.einsum(
+            "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
+        ) * (hd ** -0.5)  # (n_kv, group, S, n_ctx)
+
+    key_pos = jnp.arange(cfg.n_ctx)
+    q_pos = positions  # (S,)
+    mask = key_pos[None, :] <= q_pos[:, None]  # causal over the whole ring
+    if cfg.sliding_window:
+        mask &= key_pos[None, :] > q_pos[:, None] - cfg.sliding_window
+    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    if quant:
+        # same trick on V: probs·(q·s) == (probs·s)·q — fold the value
+        # scales into the (tiny) probability matrix, contract int8
+        probs = (jax.nn.softmax(scores, axis=-1)
+                 * cvs[:, None, None, :]).astype(qg.dtype)
+        ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv.astype(qg.dtype))
+    else:
+        probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
+        ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv)  # (n_kv, group, S, hd)
+    return ctx.transpose(2, 0, 1, 3).reshape(S, cfg.n_heads * hd).astype(out_dtype)
+
+
 def _layer(h, layers, i, cache, positions, pos_offset,
            cfg: ModelConfig):
     """One transformer block over S tokens against layer ``i`` of the
@@ -185,47 +235,48 @@ def _layer(h, layers, i, cache, positions, pos_offset,
             interpret=use_interpret(),
         ).reshape(S, cfg.n_heads * hd).astype(h.dtype)
     else:
-        # (S, n_kv, group, hd) → (n_kv, group, S, hd)
-        qg = q.reshape(S, n_kv, group, hd).transpose(1, 2, 0, 3)
-        kk = ck                     # (n_kv, n_ctx, hd) — head-major already
-        vv = cv
-        if quant:
-            # scores are linear in K, so the per-token scale factors out of
-            # the contraction: einsum over the RAW int8 ring (the int8→bf16
-            # convert fuses into the dot's operand read — HBM moves int8),
-            # then scale each key column once.  No dequantized ring is ever
-            # materialized.
-            scores = jnp.einsum(
-                "ngsh,nch->ngsc", qg, kk.astype(qg.dtype),
-                preferred_element_type=jnp.float32,
-            ) * (hd ** -0.5) * cks[:, None, None, :]
-        else:
-            scores = jnp.einsum(
-                "ngsh,nch->ngsc", qg, kk, preferred_element_type=jnp.float32
-            ) * (hd ** -0.5)  # (n_kv, group, S, n_ctx)
-
-        key_pos = jnp.arange(cfg.n_ctx)
-        q_pos = positions  # (S,)
-        mask = key_pos[None, :] <= q_pos[:, None]  # causal over the whole ring
-        if cfg.sliding_window:
-            mask &= key_pos[None, :] > q_pos[:, None] - cfg.sliding_window
-        scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
-        if quant:
-            # same trick on V: probs·(q·s) == (probs·s)·q — fold the value
-            # scales into the (tiny) probability matrix, contract int8
-            probs = (jax.nn.softmax(scores, axis=-1)
-                     * cvs[:, None, None, :]).astype(qg.dtype)
-            ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv.astype(qg.dtype))
-        else:
-            probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
-            ctx = jnp.einsum("ngsc,nch->ngsh", probs, vv)  # (n_kv, group, S, hd)
-        ctx = ctx.transpose(2, 0, 1, 3).reshape(S, cfg.n_heads * hd).astype(h.dtype)
+        ctx = xla_attention(q, ck, cv, cks, cvs, positions, cfg, h.dtype)
     h = h + lin(ctx, "wo")
 
     hn = rms_norm(h, layers["ffn_norm"][i], cfg.rms_eps)
     gated = jax.nn.silu(lin(hn, "w_gate").astype(jnp.float32)).astype(h.dtype)
     h = h + lin(gated * lin(hn, "w_up"), "w_down")
     return h, cache
+
+
+def _loop_unroll(params: dict, cfg: ModelConfig, S: int):
+    """(effective layers-per-launch, weight plan) for this trace — (0,
+    None) selects the per-layer path.  All inputs are trace-time static;
+    every ineligible armed configuration is attributed once (log + the
+    /debug/compiles degrade ledger) via
+    :func:`..ops.pallas.decode_loop.note_degrade` so a pod that silently
+    serves per-layer decode can always explain why."""
+    if not cfg.decode_layer_unroll or S != 1:
+        return 0, None   # off, or a prefill/verify trace: not a decode step
+    from ..ops.pallas.decode_loop import (
+        decode_loop_disabled,
+        effective_unroll,
+        loop_geometry,
+        note_degrade,
+    )
+
+    if cfg.attn_impl == "ring":
+        # sp-sharded rings gate off: the ring collectives cross chips,
+        # which a single fused kernel cannot (docs/RUNBOOK.md)
+        note_degrade("decode_loop",
+                     "attn_impl=ring (sequence-parallel) serves per-layer")
+        return 0, None
+    from .params import decode_loop_plan
+
+    fmts, reason = decode_loop_plan(params, cfg)
+    if reason is not None:
+        note_degrade("decode_loop", reason)
+        return 0, None
+    reason = decode_loop_disabled(loop_geometry(cfg, fmts))
+    if reason is not None:
+        note_degrade("decode_loop", reason)
+        return 0, None
+    return effective_unroll(cfg), fmts
 
 
 def forward(
@@ -256,15 +307,30 @@ def forward(
                 f"stacked leaf {name} has {leaf.shape[0]} layers but "
                 f"cfg.n_layers={cfg.n_layers}")
 
-    # fori_loop (not scan with cache xs/ys): the stacked cache rides the
-    # carry and each layer writes only its S new token slots in place —
-    # scan's ys-restack rewrites the entire ring every call (~256 MB/token
-    # at n_ctx 1024, ~2 GB at 8192 — measured as most of the 8k decode gap)
-    def body(i, carry):
-        return _layer(carry[0], params["layers"], jnp.int32(i), carry[1],
-                      positions, pos_offset, cfg)
+    # Layer-looped decode (ROADMAP item 2; "Kernel Looping", PAPERS.md):
+    # with ``cfg.decode_layer_unroll`` armed, a single-token decode step
+    # runs K layers per Pallas launch instead of the per-layer kernel
+    # chain — O(L/K) launches per step instead of O(L × ops).  Trace-time
+    # selection: S, the config knob, the weight-plan eligibility and the
+    # probe-degrade flag are all static, so the per-layer path below
+    # compiles exactly as before whenever the loop is off or ineligible.
+    K, loop_fmts = _loop_unroll(params, cfg, S)
+    if K:
+        from ..ops.pallas.decode_loop import forward_layers_looped
 
-    h, new_cache = jax.lax.fori_loop(0, cfg.n_layers, body, (h, cache))
+        h, new_cache = forward_layers_looped(
+            params["layers"], cfg, h, pos_offset, cache, K, loop_fmts)
+    else:
+        # fori_loop (not scan with cache xs/ys): the stacked cache rides the
+        # carry and each layer writes only its S new token slots in place —
+        # scan's ys-restack rewrites the entire ring every call (~256
+        # MB/token at n_ctx 1024, ~2 GB at 8192 — measured as most of the
+        # 8k decode gap)
+        def body(i, carry):
+            return _layer(carry[0], params["layers"], jnp.int32(i), carry[1],
+                          positions, pos_offset, cfg)
+
+        h, new_cache = jax.lax.fori_loop(0, cfg.n_layers, body, (h, cache))
 
     out_w = params["output"]
     if return_all:

@@ -138,7 +138,8 @@ class DevtimeRegistry:
     # _armed is the single hot-path bool, read without the lock by design
     _GUARDED_BY = {"_programs": "_lock", "_events": "_lock",
                    "_seq": "_lock", "storms_total": "_lock",
-                   "events_dropped": "_lock", "_floor": "_lock"}
+                   "events_dropped": "_lock", "_floor": "_lock",
+                   "_degrades": "_lock"}
     _SHARED_ATOMIC = ("_armed", "budget")
 
     def __init__(self, armed: bool | None = None, budget: int | None = None):
@@ -160,6 +161,13 @@ class DevtimeRegistry:
         #: compiles inside one scrape interval and the tail was lost
         self.events_dropped = 0
         self._floor = 0        # events at or below this were reset, not dropped
+        #: degrade ledger: {(program, reason) -> count} decisions where a
+        #: registered program was NOT served (probe failure, ineligible
+        #: config) and a slower path took over — the /debug/compiles
+        #: attribution the kernel-degrade contract (KER002) promises.
+        #: Bounded: distinct (program, reason) pairs are capped; repeats
+        #: only bump counts (trace-time producers, never the hot path).
+        self._degrades: OrderedDict[tuple, dict] = OrderedDict()
         self.budget = max(1, int(budget))
         self._armed = bool(armed)
 
@@ -185,6 +193,7 @@ class DevtimeRegistry:
                 p.compiles = p.dispatches = p.storms = 0
                 p.compile_s = 0.0
             self._events.clear()
+            self._degrades.clear()
             self.storms_total = 0
             self.events_dropped = 0
             self._floor = self._seq    # cleared events are not "dropped"
@@ -215,6 +224,42 @@ class DevtimeRegistry:
         with self._lock:
             self._program(name, ENTRY, site)
         return _TimedJit(self, name, fn)
+
+    #: distinct (program, reason) degrade pairs retained; repeats past the
+    #: bound still count into the OLDEST entry's overflow marker
+    MAX_DEGRADES = 32
+
+    def record_degrade(self, program: str, reason: str) -> None:
+        """Attribute one degrade decision: ``program`` exists in the
+        inventory but a slower path is serving in its place (Mosaic probe
+        failure, ineligible weights/config).  Trace/probe-time producer —
+        a retrace of the same decision bumps the count, it never grows
+        the ledger.  Surfaced in :meth:`snapshot` (``/debug/compiles``)
+        so "why is this pod not running kernel X" is answerable from the
+        pod itself."""
+        key = (program, str(reason)[:400])
+        with self._lock:
+            self._program(program, INNER, None)   # inventory-visible
+            entry = self._degrades.get(key)
+            if entry is not None:
+                entry["count"] += 1
+                return
+            if len(self._degrades) >= self.MAX_DEGRADES:
+                # keep the ledger bounded; fold the tail into a marker
+                key = (program, "(degrade ledger full — older distinct "
+                                "reasons folded)")
+                entry = self._degrades.get(key)
+                if entry is not None:
+                    entry["count"] += 1
+                    return
+            self._degrades[key] = {"program": key[0], "reason": key[1],
+                                   "count": 1, "at": time.time()}
+
+    def degrades(self) -> list[dict]:
+        """The degrade ledger (insertion order) — /debug/compiles and the
+        decode-loop tests read it."""
+        with self._lock:
+            return [dict(v) for v in self._degrades.values()]
 
     # -- producer API ------------------------------------------------------
     def record_dispatch(self, name: str, n: int = 1) -> None:
@@ -338,6 +383,7 @@ class DevtimeRegistry:
             return {"armed": self._armed, "budget": self.budget,
                     "storms_total": self.storms_total,
                     "events_dropped": self.events_dropped,
+                    "degrades": [dict(v) for v in self._degrades.values()],
                     "programs": programs}
 
 

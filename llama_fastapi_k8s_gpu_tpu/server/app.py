@@ -1199,6 +1199,11 @@ def create_app(engine=None, settings: Settings | None = None,
                 # capacity win, verifiable per pod (docs/KV_CACHE.md)
                 "kv_dtype": getattr(cfg, "kv_dtype", None),
                 "kv_cache_bytes": getattr(eng, "kv_cache_bytes", None),
+                # layer-looped decode (ops/pallas/decode_loop.py): the
+                # EFFECTIVE layers-per-launch this pod serves (-1/K are
+                # clamped to the real divisor; 0 after any degrade, with
+                # the reason in /debug/compiles)
+                "decode_layer_unroll": _effective_unroll(cfg),
             }
             # paged KV pool occupancy (LFKT_KV_PAGED): pages used/free/
             # pinned, the spill tier, and the hit/eviction counters —
@@ -1531,6 +1536,20 @@ def create_app(engine=None, settings: Settings | None = None,
     return app
 
 
+def _effective_unroll(cfg):
+    """The decode layers-per-launch ``cfg`` actually serves — the
+    ``-1`` / nearest-divisor clamp applied (ops/pallas/decode_loop.py)
+    — or None for engines whose config predates the field (fakes)."""
+    if getattr(cfg, "decode_layer_unroll", None) is None:
+        return None
+    from ..ops.pallas.decode_loop import effective_unroll
+
+    try:
+        return effective_unroll(cfg)
+    except (AttributeError, TypeError, ValueError):
+        return 0
+
+
 def _base_engine_kwargs(settings: Settings) -> dict:
     """Engine-constructor kwargs shared by the single-model factory and
     every registry entry (which then applies its manifest overrides)."""
@@ -1542,6 +1561,7 @@ def _base_engine_kwargs(settings: Settings) -> dict:
         max_gen_tokens=settings.max_gen_tokens,
         attn_impl=settings.attn_impl,
         kv_dtype=settings.kv_dtype,
+        decode_layer_unroll=settings.decode_layer_unroll,
         spec_decode=settings.spec_decode,
         spec_draft=settings.spec_draft,
         prefix_cache=settings.prefix_cache,

@@ -118,6 +118,14 @@ class Settings:
 
     # TPU-native knobs (no reference equivalent).
     max_gen_tokens: int = 512
+    # layer-looped decode (ops/pallas/decode_loop.py; ROADMAP item 2):
+    # transformer layers fused per Pallas launch on the single-token
+    # decode step.  0 = off (the per-layer kernel chain), -1 = ALL layers
+    # in one launch, K > 0 = K layers per launch (clamped to a divisor of
+    # n_layers).  Engines compile-probe the looped kernel at their ring
+    # geometry and degrade to per-layer decode with attribution on any
+    # refusal (docs/RUNBOOK.md "Tuning layer-looped decode").
+    decode_layer_unroll: int = 0
     decode_chunk: int = 8           # device-side tokens per host round-trip.
     # Measured trade-off (docs/bench 2026-07-30): single-stream decode
     # rises mildly with chunk size (+~1% at 1k ctx, +4.7% at 8k for 32 vs
@@ -290,6 +298,9 @@ KNOBS: dict[str, Knob] = _register(
     Knob("LFKT_REPEAT_PENALTY", float, "repetition penalty"),
     # -- TPU-native engine knobs -------------------------------------------
     Knob("LFKT_MAX_GEN_TOKENS", int, "default completion budget"),
+    Knob("LFKT_DECODE_LAYER_UNROLL", int,
+         "layers fused per decode-step Pallas launch (0 = per-layer, "
+         "-1 = all layers; ops/pallas/decode_loop.py)", serving=True),
     Knob("LFKT_DECODE_CHUNK", int, "device tokens per host round-trip"),
     Knob("LFKT_PREFILL_BUCKETS", str, "padded prompt shapes (csv)"),
     Knob("LFKT_WEIGHT_FORMAT", str, "auto|bf16|int8|q4k"),

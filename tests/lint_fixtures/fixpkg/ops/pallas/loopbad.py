@@ -1,0 +1,34 @@
+"""Planted KER002: a layer-looped decode-kernel VARIANT with no probe.
+
+The decode-loop contract (ISSUE 12): every looped program shape an engine
+can arm must be covered by a startup compile probe, or a Mosaic failure
+crash-loops warmup instead of degrading to the per-layer path.  This
+fixture plants exactly that rot — an ``interpret=``-gated (KER001-clean)
+looped variant that no probe.py imports and that defines no in-module
+XLA fallback — and the self-test pins that KER002 fires on it.
+"""
+
+import jax
+from jax.experimental import pallas as pl
+
+K_LAYERS = 4
+
+
+def _loop_kernel(h_ref, w_ref, o_ref):
+    o_ref[...] = h_ref[...] @ w_ref[...]
+
+
+def looped_decode_variant(h, w, interpret=False):
+    # gated (no KER001) and statically blocked (no KER003) — but
+    # unprobed and fallback-less: KER002 must fire for this module
+    return pl.pallas_call(
+        _loop_kernel,
+        grid=(K_LAYERS,),
+        in_specs=[
+            pl.BlockSpec((1, 128), lambda l: (0, 0)),
+            pl.BlockSpec((1, 128, 128), lambda l: (l, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 128), lambda l: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct(h.shape, h.dtype),
+        interpret=interpret,
+    )(h, w)

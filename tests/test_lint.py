@@ -106,6 +106,7 @@ def _fired(rule, path_part, suppressed=False):
     ("OBS003", "obsbad.py", 1),     # phantom memledger component
     ("KER001", "kernbad.py", 1),    # pallas_call without interpret=
     ("KER002", "kernbad.py", 1),    # no probe, no fallback
+    ("KER002", "loopbad.py", 1),    # unprobed layer-looped decode variant
     ("KER003", "kernbad.py", 1),    # call inside a block shape
     ("PERF001", "perfbad.py", 3),   # decorator + jit-call + pallas_call forms
     ("PERF002", "obs/slo.py", 1),   # SLO over a phantom metric family
@@ -376,7 +377,8 @@ def test_ci_gate_aggregates_lint_and_manifest():
     doc = json.loads(proc.stdout)
     assert doc["ok"] is True
     names = {c["name"] for c in doc["checks"]}
-    assert names == {"lfkt-lint", "check-manifest", "incident-schema"}
+    assert names == {"lfkt-lint", "check-manifest", "incident-schema",
+                     "decode-loop-parity"}
     assert all(c["exit"] == 0 for c in doc["checks"])
 
 

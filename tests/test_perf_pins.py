@@ -193,6 +193,61 @@ def test_sp_request_dispatch_budget(pins):
         "sp_decode_chunk": (0, 2)}
 
 
+# ---------------------------------------------------------------------------
+# per-decode-step KERNEL-LAUNCH pins (ISSUE 12): the layer-loop collapse
+# proven deterministically on CPU, via the jaxpr launch audit
+# (obs/launches.py) — launch primitives weighted by layer-loop trip count
+# ---------------------------------------------------------------------------
+
+def _launch_audit(unroll: int, kv_dtype: str = "bf16"):
+    import dataclasses
+
+    from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+    from llama_fastapi_k8s_gpu_tpu.models.params import synth_params
+    from llama_fastapi_k8s_gpu_tpu.obs.launches import decode_step_launches
+
+    cfg = ModelConfig(vocab_size=64, dim=64, n_layers=8, n_heads=4,
+                      n_kv_heads=2, ffn_dim=96, n_ctx=32, kv_dtype=kv_dtype,
+                      decode_layer_unroll=unroll)
+    return decode_step_launches(synth_params(cfg), cfg)
+
+
+def test_per_layer_decode_step_launch_pin():
+    # the per-layer chain: 7 linears + 2 attention contractions = 9 launch
+    # primitives per layer, × L=8 in the layer loop, + the output head.
+    # A new dot on the decode path (or a lost loop) changes these exact
+    # integers and fails here, on CPU, before any chip session pays for it.
+    audit = _launch_audit(0)
+    assert audit["loop_trips"] == [8]
+    assert audit["in_loop"] == 8 * 9
+    assert audit["outside"] == 1          # the output head
+    assert audit["while_loops"] == 0      # trip counts are all static
+
+
+def test_looped_decode_step_launch_pin():
+    import math
+
+    base = _launch_audit(0)
+    for K in (4, 8, -1):
+        audit = _launch_audit(K)
+        eff = 8 if K == -1 else K
+        in_step = audit["total"] - base["outside"]   # minus the output head
+        # THE acceptance criterion: K layers per launch → ≤ ceil(L/K)
+        # kernel launches per decode step (one pallas_call per group)
+        assert in_step <= math.ceil(8 / eff), (K, audit)
+        assert audit["total"] * 3 <= base["total"], (K, audit, base)
+    # and the collapse is attributed to the looped kernel, not to dots
+    a4 = _launch_audit(4)
+    assert a4["by_prim"].get("pallas_call") == 2
+    assert "dot_general" not in a4["by_prim"]        # none left in-loop
+
+
+def test_looped_launch_pin_int8_kv():
+    # the int8-KV fused-dequant reads stay inside the loop: same collapse
+    audit = _launch_audit(4, kv_dtype="int8")
+    assert audit["total"] - 1 <= 2, audit
+
+
 def test_continuous_request_budget(pins):
     d = pins["cont_req"]
     # zero compiles anywhere: admission, lane write, decode, harvest

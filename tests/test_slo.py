@@ -266,7 +266,9 @@ async def test_debug_slo_and_compiles_schemas():
             "verdict"} <= set(doc["recompile"])
     comp = compiles.json()
     assert set(comp) == {"armed", "budget", "storms_total",
-                         "events_dropped", "programs"}
+                         "events_dropped", "degrades", "programs"}
+    for d in comp["degrades"]:   # the kernel-degrade attribution ledger
+        assert {"program", "reason", "count"} <= set(d)
     for p in comp["programs"]:
         assert {"name", "kind", "compiles", "dispatches",
                 "signatures", "signature_list"} <= set(p)

@@ -35,6 +35,17 @@ CHECKS: list[tuple[str, list[str]]] = [
     ("incident-schema", [sys.executable,
                          os.path.join(ROOT, "tools", "incident_report.py"),
                          "--validate"]),
+    # layer-looped decode bit-exactness (ISSUE 12): the serial-engine
+    # greedy-parity subset of tests/test_decode_loop.py, standalone —
+    # greedy output with LFKT_DECODE_LAYER_UNROLL armed must stay
+    # bit-identical to the per-layer path (bf16/int8 KV, dense/paged).
+    # `env JAX_PLATFORMS=cpu`: this gate must never touch (or queue on)
+    # the single-session device tunnel.
+    ("decode-loop-parity", ["env", "JAX_PLATFORMS=cpu", sys.executable,
+                            "-m", "pytest", "-q", "-p", "no:cacheprovider",
+                            os.path.join(ROOT, "tests",
+                                         "test_decode_loop.py"),
+                            "-k", "serial_parity"]),
 ]
 
 
