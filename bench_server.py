@@ -77,6 +77,15 @@ def main() -> None:
     # across lanes via /v1/chat/completions — per-model agg tok/s says
     # what co-residency costs vs a single-model pod
     mixed_models = os.environ.get("LFKT_BENCH_MIXED_MODELS") == "1"
+    # disagg arm (serving/disagg/): the two-role LOOPBACK split —
+    # role=both on one serial paged engine, so every cold prompt's
+    # prefill crosses the full page wire (serialize → TCP → deserialize
+    # → import → restore) — reported against a role-off control run of
+    # the same fresh-prompt workload.  On one host this measures the
+    # transfer OVERHEAD the split pays; across hosts the same wire buys
+    # the prefill/decode interference removal (docs/RUNBOOK.md
+    # "Operating a split prefill/decode fleet").
+    disagg_arm = os.environ.get("LFKT_BENCH_DISAGG") == "1"
     from llama_fastapi_k8s_gpu_tpu.utils.config import env_bool
 
     lane_prefix = env_bool("LFKT_LANE_PREFIX_CACHE")
@@ -118,6 +127,12 @@ def main() -> None:
     if kv_dtype != "bf16":
         wfmt = f"{wfmt},kv-{kv_dtype}"
     batch = int(os.environ.get("LFKT_BENCH_BATCH", "1"))
+    if disagg_arm and batch > 1:
+        raise SystemExit(
+            "LFKT_BENCH_DISAGG=1 measures the serial two-role loopback; "
+            "set LFKT_BENCH_BATCH=1 (the continuous-scheduler split rides "
+            "the same client — bench it via LFKT_DISAGG_ROLE on a real "
+            "two-process fleet)")
     if mixed_models and batch <= 1:
         raise SystemExit(
             "LFKT_BENCH_MIXED_MODELS=1 needs LFKT_BENCH_BATCH>1: the arm "
@@ -197,6 +212,14 @@ def main() -> None:
         # prefill to one suffix bucket and the TTFT metric (same name as
         # prior rounds') would stop measuring full-stack prefill latency.
         # The multiturn mode measures the reuse path, explicitly labeled.
+        paged_kw = {}
+        if disagg_arm:
+            # the page wire needs the paged pool; small pages at tiny
+            # scale so the fresh-prompt grid actually crosses page
+            # boundaries (serial reuse is page-aligned)
+            paged_kw = dict(kv_paged=True,
+                            kv_page_tokens=32 if preset == "tiny"
+                            else settings.kv_page_tokens)
         eng = Engine.from_parts(params, cfg, tok, template_kind="llama3",
                                 max_gen_tokens=max_tokens,
                                 attn_impl=cfg.attn_impl,
@@ -205,7 +228,8 @@ def main() -> None:
                                 spec_draft=spec_draft,
                                 prefix_cache=multiturn,
                                 prefill_chunk=settings.prefill_chunk,
-                                prefill_overlap=settings.prefill_overlap)
+                                prefill_overlap=settings.prefill_overlap,
+                                **paged_kw)
     # compile every shape BEFORE the server phase, exactly like the
     # production factory (server/app.py calls eng.warmup() at startup);
     # without it the first request compiles for ~60 s and the 25 s
@@ -340,6 +364,114 @@ def main() -> None:
         if first is None:
             first = (time.perf_counter() - t0) * 1e3
         return first, "".join(parts), err
+
+    if disagg_arm:
+        # LFKT_BENCH_DISAGG=1: the same engine serves both halves over
+        # loopback TCP — control phase first (role off: the engine's
+        # _disagg gate is None), then the client is installed and the
+        # identical fresh-prompt workload re-runs through the wire.
+        from llama_fastapi_k8s_gpu_tpu.serving.disagg.decoder import (
+            DisaggClient,
+        )
+        from llama_fastapi_k8s_gpu_tpu.serving.disagg.prefiller import (
+            PrefillServer,
+        )
+
+        psrv = PrefillServer(eng, host="127.0.0.1", port=0,
+                             metrics=app.state.metrics)
+        pcli = DisaggClient(f"127.0.0.1:{psrv.port}", eng._kvpool,
+                            timeout_s=60.0, metrics=app.state.metrics)
+
+        # a prompt long enough that the serial paged-reuse constraints
+        # grant page-aligned reuse (bucket > smallest bucket, suffix
+        # fits a smaller one) — sized with the REAL tokenizer
+        filler_ids = tok.encode(
+            "The quick brown fox jumps over the lazy dog near the old "
+            "riverbank while autumn leaves drift slowly down. " * 40)
+        filler = tok.decode(filler_ids[:min(150, cfg.n_ctx // 2)])
+
+        def disagg_payload(tag: str) -> bytes:
+            # the tag leads, so every request's FIRST page differs —
+            # each sample is a cold radix miss and the hop must fire
+            return json.dumps({
+                "bot_profile": {
+                    "name": "Ada",
+                    "appearance": "tall, green eyes, red hair, calm voice",
+                    "system_prompt": "You are a concise assistant.",
+                },
+                "user_profile": {"name": "Sam"},
+                "context": [{"turn": "user",
+                             "message": (f"[{tag}] " + filler)[:400]}],
+            }).encode()
+
+        pq = lambda v, q: v[min(len(v) - 1, int(q * len(v)))]  # noqa: E731
+
+        def read_metric_sum(name: str) -> float | None:
+            # streamed responses meter into the LABELED per-model family
+            # (tokens_generated_total{model=...}) — sum its series
+            try:
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=30) as r:
+                    text = r.read().decode()
+            except Exception:  # noqa: BLE001 — measurement aid
+                return None
+            total, found = 0.0, False
+            for ln in text.splitlines():
+                head, _, val = ln.rpartition(" ")
+                if head == name or head.startswith(name + "{"):
+                    total += float(val)
+                    found = True
+            return total if found else None
+
+        def disagg_phase(label: str) -> dict:
+            before = read_metric_sum("tokens_generated_total")
+            samples = []
+            t0p = time.perf_counter()
+            for i in range(n_req):
+                ms, _text, err = stream_ttft(disagg_payload(f"{label}{i}"))
+                if err is None:
+                    samples.append(ms)
+                else:
+                    print(f"bench_server: disagg {label} stream error: "
+                          f"{err}", file=sys.stderr, flush=True)
+            wall = time.perf_counter() - t0p
+            after = read_metric_sum("tokens_generated_total")
+            gen = (after - (before or 0.0)
+                   if after is not None else None)
+            samples.sort()
+            return {
+                "ttft_ms_p50": (round(pq(samples, 0.5), 1)
+                                if samples else None),
+                "ttft_ms_p95": (round(pq(samples, 0.95), 1)
+                                if samples else None),
+                "samples": len(samples),
+                "agg_tok_s": (round(gen / wall, 1)
+                              if gen and wall > 0 else None),
+                "gen_tokens": int(gen) if gen is not None else None,
+                "wall_s": round(wall, 1),
+            }
+
+        control = disagg_phase("ctl")      # role off: one attribute read
+        eng.install_disagg(pcli)
+        split = disagg_phase("dis")
+        result = {
+            "metric": (f"server_ttft_ms_p50[/response,{preset},{wfmt}"
+                       ",disagg-loopback]"),
+            "value": split["ttft_ms_p50"] or 0.0,
+            "unit": "ms",
+            "control": control,
+            "disagg": split,
+            "disagg_client": pcli.status(),
+            "disagg_service": psrv.status(),
+            "kv_page_tokens": eng._kvpool.page_tokens,
+            "max_tokens": max_tokens,
+            "n_requests": n_req,
+            "warmup_s": round(warm_s, 1),
+            "decode_chunk": settings.decode_chunk,
+            "device": str(dev),
+        }
+        emit_result(result)
+        os._exit(0)  # daemon server thread: skip graceful asyncio teardown
 
     if mixed_models:
         # LFKT_BENCH_MIXED_MODELS=1 + LFKT_BENCH_BATCH=B: `conc` worker

@@ -740,6 +740,15 @@ class ContinuousEngine(MeshEngine):
                     f"Requested tokens ({len(ids)}) exceed context window "
                     f"of {self.cfg.n_ctx}")
             bucket = self._bucket_for(len(ids))
+            # disagg decode role (serving/disagg/): one bounded remote-
+            # prefill hop per admission — the peer's pages import into
+            # the shared pool, so _paged_admission_reuse below restores
+            # them and the suffix slices are all this wave prefills
+            # locally (the "decode-only waves" shape).  Role off is one
+            # attribute read; the client bounds the hop by the item's
+            # deadline and degrades every failure to local prefill.
+            if self._disagg is not None and item.seed is None:
+                self._remote_prefill_ids(ids, item.deadline, pspan)
             reuse, src = 0, None
             if item.seed is None:
                 # explicit seeds take the full prefill: the suffix pass

@@ -45,7 +45,7 @@ from ..sampling.sample import SamplingParams, sampling_tensors, seed_window
 from ..tokenizer import apply_chat_template, detect_chat_template, tokenizer_from_gguf
 from ..obs.memledger import register_component, tree_nbytes
 from ..utils.faults import FAULTS
-from ..utils.health import Heartbeat
+from ..utils.health import DeadlineExceeded, Heartbeat
 from ..utils.jaxcache import setup_compile_cache
 from ..utils.tracing import maybe_profile
 
@@ -518,6 +518,13 @@ class Engine:
                     sink_host=self)
         else:
             self._kvpool = None
+        #: disaggregated prefill/decode (serving/disagg/): the decode
+        #: replica's remote-prefill client, installed by install_disagg()
+        #: when LFKT_DISAGG_ROLE is decode|both.  None (the default) is
+        #: THE off state — the serving paths gate on a single attribute
+        #: read, so a role=off pod pays nothing (poisoned-client pin,
+        #: tests/test_disagg.py).
+        self._disagg = None
         # -- lfkt-mem: report this engine's allocation surfaces into the
         # process memory ledger (obs/memledger.py).  Weakly held — a
         # discarded engine's rows vanish with it; providers read live
@@ -887,14 +894,18 @@ class Engine:
 
     # ------------------------------------------------------------------
     def _start(self, messages, sp: SamplingParams, seed,
-               espan=None):  # lfkt: holds[_lock]
+               espan=None, pre_ids=None):  # lfkt: holds[_lock]
         """Shared prefill + first-token path. Returns a mutable gen context.
         ``espan`` (the traced request's ``engine`` span, or None) grows a
-        ``prefill`` child covering tokenize → first sampled token."""
+        ``prefill`` child covering tokenize → first sampled token.
+        ``pre_ids`` is the prompt already tokenized by the pre-lock
+        disagg hop (_remote_prefill) so the request never pays the chat
+        template + tokenizer twice."""
         t0 = time.time()
         self.heartbeat.beat()
         FAULTS.fire("prefill")
-        ids = self.tokenize_messages(messages)
+        ids = pre_ids if pre_ids is not None \
+            else self.tokenize_messages(messages)
         n_prompt = len(ids)
         if n_prompt >= self.cfg.n_ctx:
             raise ValueError(
@@ -1043,6 +1054,128 @@ class Engine:
         if pspan is not None:
             pspan.set(reused_pages=len(lease.page_ids), matched_tokens=i)
         return lease.tokens
+
+    # -- disaggregated prefill/decode (serving/disagg/) -----------------
+    def install_disagg(self, client) -> None:
+        """Arm remote prefill (LFKT_DISAGG_ROLE=decode|both): admitted
+        prompts hop to the prefill tier, whose pages import into the
+        local pool's radix, so :meth:`_paged_reuse` (and the continuous
+        scheduler's admission reuse) restores them like any local
+        commit.  Requires the paged pool — pages ARE the wire format."""
+        if self._kvpool is None:
+            raise ValueError(
+                "install_disagg requires LFKT_KV_PAGED=1: the disagg "
+                "wire ships KV pool pages (docs/RUNBOOK.md 'Operating a "
+                "split prefill/decode fleet')")
+        self._disagg = client
+
+    def _remote_prefill(self, messages, deadline, trace) -> list | None:
+        """One bounded remote-prefill hop for the serial path, BEFORE the
+        generation lock (in `both` mode the loopback page service takes
+        that lock to prefill — holding it here would deadlock).  Never
+        raises: tokenize errors re-raise properly inside _start, and the
+        client degrades every wire failure to local prefill itself.
+        Returns the tokenized prompt so _start never re-tokenizes (None
+        when tokenization failed — _start then raises the real error)."""
+        try:
+            ids = self.tokenize_messages(messages)
+        except Exception:  # noqa: BLE001 — _start re-raises the real error
+            return None
+        try:
+            if len(ids) >= self.cfg.n_ctx:
+                return ids              # _start's oversized-prompt 400
+            span = trace.span("disagg") if trace is not None else None
+            try:
+                self._disagg.prefetch(ids, namespace=self._kv_ns,
+                                      deadline=deadline, span=span)
+            finally:
+                if span is not None:
+                    span.end()
+        except Exception:  # noqa: BLE001 — remote prefill is an
+            # optimization: any failure here must degrade to the local
+            # prefill _start runs anyway, never fail the request
+            logger.exception("disagg prefetch failed; serving local "
+                             "prefill")
+        return ids
+
+    def _remote_prefill_ids(self, ids, deadline, span=None) -> None:
+        """Tokenized variant (the continuous scheduler's admission path,
+        engine/continuous.py _begin_admission).  Same never-raise
+        contract as :meth:`_remote_prefill`."""
+        try:
+            self._disagg.prefetch(ids, namespace=self._kv_ns,
+                                  deadline=deadline, span=span)
+        except Exception:  # noqa: BLE001 — degrade to local prefill
+            logger.exception("disagg prefetch failed; serving local "
+                             "prefill")
+
+    def prefill_to_pages(self, ids, *, namespace: str = "",
+                         deadline=None):
+        """The prefill TIER's page service (serving/disagg/prefiller.py):
+        ensure the whole-page prefix of ``ids`` is committed in the
+        local pool — consulting the tier's own radix first, so a system
+        prompt hot across many decode replicas prefills once per tier,
+        then prefilling into the serial ring (which serves nothing else
+        on a prefill-role pod) — pin it, export host page stacks,
+        release.  Returns ``(leaves, tokens, first_token)`` or None when
+        no whole page is exportable; ``first_token`` is the prompt's
+        greedy continuation when this call ran the prefill (advisory —
+        the decode side samples its own first token from the restored
+        prefix, bit-identical by the suffix-prefill contract), else
+        None."""
+        pool = self._kvpool
+        if pool is None:
+            raise ValueError(
+                "prefill_to_pages requires LFKT_KV_PAGED=1 (pages are "
+                "the disagg wire format)")
+        T = pool.page_tokens
+        ids = list(ids)
+        n_prompt = len(ids)
+        if n_prompt >= self.cfg.n_ctx:
+            raise ValueError(
+                f"Requested tokens ({n_prompt}) exceed context window "
+                f"of {self.cfg.n_ctx}")
+        keep = (n_prompt // T) * T
+        if keep < T:
+            return None                  # prompt shorter than one page
+        first_token = None
+        with self._lock:
+            self.heartbeat.enter()
+            try:
+                have = pool.match_len(ids[:keep], namespace=namespace)
+                if have < keep:
+                    if deadline is not None and time.time() > deadline:
+                        # PR-2 deadline propagation spans the hop: the
+                        # decode side already abandoned this request
+                        raise DeadlineExceeded(
+                            "deadline expired before remote prefill")
+                    self.heartbeat.beat()
+                    FAULTS.fire("prefill")
+                    bucket = self._bucket_for(n_prompt)
+                    logits, cache = self._prefill_padded(
+                        ids, n_prompt, bucket, self._cache)
+                    self._cache = cache
+                    self._prefix_ids = []
+                    first_token = int(jnp.argmax(logits))
+                    pool.commit(ids[:keep], self._cache,
+                                namespace=namespace)
+                # commit may have degraded to the leading portion that
+                # fit (squeezed pool): export what the index truly holds
+                have = min(pool.match_len(ids[:keep], namespace=namespace),
+                           keep)
+                if have < T:
+                    return None
+                lease = pool.acquire(ids[:keep], have, namespace=namespace)
+                if lease is None:        # raced an eviction: a miss, not
+                    return None          # an error — the peer falls back
+                try:
+                    leaves = pool.export_pages(lease)
+                    tokens = lease.tokens
+                finally:
+                    pool.release(lease)
+                return leaves, tokens, first_token
+            finally:
+                self.heartbeat.leave()
 
     def _finish(self, ctx) -> dict:  # lfkt: holds[_lock]
         """Return the cache buffer for reuse; finalize per-phase timings.
@@ -1368,11 +1501,19 @@ class Engine:
 
     def _generate(self, messages, sp, max_tokens, stops, seed,
                   deadline=None, abort=None, trace=None) -> dict:
+        # disagg decode role: one bounded remote-prefill hop BEFORE the
+        # generation lock (loopback mode's page service needs it); role
+        # off (`_disagg is None`, the default) costs this one attribute
+        # read.  Explicit seeds bypass like every reuse path.
+        pre_ids = None
+        if self._disagg is not None and seed is None:
+            pre_ids = self._remote_prefill(messages, deadline, trace)
         with self._lock, maybe_profile("generate"):
             self.heartbeat.enter()
             try:
                 return self._generate_locked(messages, sp, max_tokens, stops,
-                                             seed, deadline, abort, trace)
+                                             seed, deadline, abort, trace,
+                                             pre_ids=pre_ids)
             except Exception as e:  # noqa: BLE001 — burst detection, re-raised
                 self._note_error(e)
                 raise
@@ -1380,11 +1521,12 @@ class Engine:
                 self.heartbeat.leave()
 
     def _generate_locked(self, messages, sp, max_tokens, stops, seed,
-                         deadline, abort, trace=None
+                         deadline, abort, trace=None, pre_ids=None
                          ) -> dict:  # lfkt: holds[_lock]
         t0 = time.time()
         ctx = self._start(messages, sp, seed,
-                          espan=self._engine_span(trace, deadline))
+                          espan=self._engine_span(trace, deadline),
+                          pre_ids=pre_ids)
         ctx["trace"] = trace
         ctx["deadline"] = deadline
         ctx["abort"] = abort
@@ -1418,11 +1560,17 @@ class Engine:
     def _generate_stream(self, messages, sp, max_tokens, stops, seed,
                          deadline=None, abort=None,
                          trace=None) -> Iterator[dict]:
+        # same pre-lock remote-prefill hop as _generate (one attribute
+        # read when LFKT_DISAGG_ROLE is off)
+        pre_ids = None
+        if self._disagg is not None and seed is None:
+            pre_ids = self._remote_prefill(messages, deadline, trace)
         with self._lock:
             self.heartbeat.enter()
             try:
                 ctx = self._start(messages, sp, seed,
-                                  espan=self._engine_span(trace, deadline))
+                                  espan=self._engine_span(trace, deadline),
+                                  pre_ids=pre_ids)
             except Exception as e:  # noqa: BLE001 — burst detection, re-raised
                 self.heartbeat.leave()
                 self._note_error(e)

@@ -57,9 +57,10 @@ logger = logging.getLogger(__name__)
 #: bundle schema version (tools/incident_report.py + ci_gate validate it)
 SCHEMA = 1
 
-#: the incident kinds the serving stack records
+#: the incident kinds the serving stack records (``disagg_peer_dead``:
+#: a decode replica's prefill peer died mid-stream — serving/disagg/)
 KINDS = ("watchdog_trip", "dead_escalation", "resource_exhausted",
-         "slo_breach")
+         "slo_breach", "disagg_peer_dead")
 
 #: bundle ids are process-minted and filesystem-safe; /debug/incidents/{id}
 #: refuses anything else (no path traversal through the id)

@@ -293,7 +293,7 @@ class KVPool:
         self.counters = {
             "hits": 0, "misses": 0, "reused_tokens": 0, "commits": 0,
             "stored_pages": 0, "evictions": 0, "spills": 0, "restores": 0,
-            "store_skips": 0,
+            "store_skips": 0, "exported_pages": 0, "imported_pages": 0,
         }
         # lfkt-mem: attribute the arena into the process memory ledger —
         # indexed pages per namespace (model), the free list, and the
@@ -349,6 +349,14 @@ class KVPool:
             (s.shape[:2] + (T,) + s.shape[3:], str(jnp.dtype(s.dtype)))
             for s in jax.tree.leaves(spec))
         return theirs == self._page_spec
+
+    def page_spec(self) -> tuple:
+        """The per-leaf page geometry fingerprint ((shape, dtype_str), ...)
+        + ``page_tokens`` is everything a peer pool must reproduce to
+        exchange pages with this one — the disagg wire handshake's
+        geometry block (serving/disagg/wire.py).  Immutable metadata: no
+        lock needed."""
+        return self._page_spec
 
     # ------------------------------------------------------------------
     # public surface (each entry point takes the lock once)
@@ -456,6 +464,68 @@ class KVPool:
                        bytes=len(lease.page_ids) * self.page_nbytes,
                        host_s=round(time.time() - t0, 6))
         return ring
+
+    def export_pages(self, lease: _Lease) -> list:
+        """Host copies of the lease's pages, one stacked array per cache
+        leaf (leading axis = page, in lease order) — the disagg wire's
+        payload unit (serving/disagg/wire.py).  The lease pins the pages,
+        so the gather can never race an eviction; the device_get is a
+        synchronous DMA exactly like the spill path's."""
+        pids = jnp.asarray(lease.page_ids, jnp.int32)
+        with self._lock:
+            leaves = jax.device_get(
+                [al[pids] for al in jax.tree.leaves(self.arena)])
+            self.counters["exported_pages"] += len(lease.page_ids)
+        return leaves
+
+    def import_pages(self, ids, leaves, *, namespace: str = "",
+                     span=None) -> int:
+        """Index externally produced KV pages — the disagg decode side
+        (serving/disagg/decoder.py): the whole-page prefix of ``ids``
+        arrives as host page stacks (one array per cache leaf, leading
+        axis = page, covering ``len(ids)//page_tokens`` pages, the
+        :meth:`export_pages` layout).  Pages already cached deduplicate
+        (LRU touch only); the new tail uploads into freshly allocated
+        arena pages and joins the tree via the SAME index-attach
+        machinery as :meth:`commit` (:meth:`_index_tail` — the radix
+        invariants cannot drift between local commits and wire imports),
+        so the next :meth:`acquire` for this prefix restores it like any
+        local commit.  Degrades exactly like commit — to the leading
+        portion that fits, or to nothing, when the pool is pinned solid
+        or a device copy fails; never blocks, never OOMs.  Returns the
+        tokens the tree now covers for this prefix (cached + newly
+        imported)."""
+        ids = list(ids)
+        T = self.page_tokens
+        with self._lock:
+            n_want = len(ids) // T
+            if n_want < 1:
+                return 0
+            if any(leaf.shape[0] != n_want for leaf in leaves):
+                raise ValueError(
+                    f"page stacks cover "
+                    f"{[leaf.shape[0] for leaf in leaves]} pages, ids "
+                    f"cover {n_want} (geometry drift on the wire?)")
+            treedef = jax.tree.structure(self.arena)
+
+            def upload(pids: list, matched: int, n_tail: int) -> None:
+                off = 0
+                while off < n_tail:
+                    g = pids[off:off + _GROUP]
+                    stack = [
+                        jnp.asarray(leaf[matched + off:
+                                         matched + off + len(g)])
+                        for leaf in leaves]
+                    self.arena = _upload_pages_jit(
+                        self.arena, jax.tree.unflatten(treedef, stack),
+                        jnp.asarray(g, jnp.int32))
+                    off += len(g)
+
+            matched, stored = self._index_tail(ids, namespace, span,
+                                               upload)
+            if stored:
+                self.counters["imported_pages"] += stored
+            return (matched + stored) * T
 
     def commit(self, ids, ring: dict, span=None, *,
                namespace: str = "") -> int:
@@ -663,47 +733,14 @@ class KVPool:
     def _commit_impl(self, ids: list, ring=None, bcache=None, lane=None,
                      span=None, namespace: str = "") -> int:
         with self._lock:
-            want = self._pages_of(ids)
-            if not want:
+            if len(ids) < self.page_tokens:
                 return 0
             self.counters["commits"] += 1
-            matched, path = self._match(ids, namespace)
-            self._clock += 1
-            for node, _n in path:
-                node.stamp = self._clock
-            if matched >= len(want):
-                return 0                       # fully cached already
-            tail = want[matched:]
-            # mark the match path busy: the tail's allocation may evict,
-            # and evicting (= unlinking) a path node would orphan the
-            # subtree this commit is about to attach to
-            self._busy.update(id(node) for node, _n in path)
-            try:
-                n = len(tail)
-                pids = self._alloc(n, span=span)
-                while pids is None and n > 1:
-                    # degrade to the leading portion that fits (halving:
-                    # O(log) alloc attempts, each of which may evict)
-                    n //= 2
-                    pids = self._alloc(n, span=span)
-            finally:
-                self._busy.clear()
-            if pids is None:
-                self.counters["store_skips"] += 1
-                return 0
-            tail = tail[:n]
-            # attach point: deepest fully-matched node, splitting a
-            # partially-matched edge at its page boundary first
-            if path and path[-1][1] < len(path[-1][0].edge):
-                parent = self._split(path[-1][0], path[-1][1])
-            elif path:
-                parent = path[-1][0]
-            else:
-                parent = self._root_for(namespace)
             T = self.page_tokens
-            off = 0
-            try:
-                while off < len(tail):
+
+            def store(pids: list, matched: int, n_tail: int) -> None:
+                off = 0
+                while off < n_tail:
                     g = jnp.asarray(pids[off:off + _GROUP], jnp.int32)
                     go = jnp.int32((matched + off) * T)
                     if ring is not None:
@@ -713,23 +750,79 @@ class KVPool:
                         self.arena = _store_lane_pages_jit(
                             self.arena, bcache, jnp.int32(lane), g, go)
                     off += len(g)
-            except Exception as e:  # noqa: BLE001 — skip the store: the
-                # cache is an optimization, a failed page copy must not
-                # fail the finished request (or the scheduler loop, on
-                # the freed-lane path); the not-yet-indexed pids go back
-                # on the free list — partially stored groups are
-                # unreachable without a tree node, hence harmless
-                self._free.extend(pids)
-                self.counters["store_skips"] += 1
-                logger.warning("page store failed; commit skipped: %s", e)
-                return 0
-            child = _Node(tail, pids, parent, namespace)
-            child.stamp = self._clock
-            parent.children[tail[0]] = child
-            self._ns_pages[namespace] = \
-                self._ns_pages.get(namespace, 0) + len(tail)
-            self.counters["stored_pages"] += len(tail)
-            return len(tail)
+
+            _matched, stored = self._index_tail(ids, namespace, span,
+                                                store)
+            return stored
+
+    def _index_tail(self, ids: list, namespace: str, span,
+                    store) -> tuple:  # lfkt: holds[_lock]
+        """THE index-attach skeleton shared by :meth:`commit` /
+        :meth:`commit_lane` (device-side ring/lane store) and
+        :meth:`import_pages` (host-stack upload, the disagg wire): match
+        + LRU-touch, busy-pin the match path, allocate the tail with the
+        halving degrade, split/attach, run ``store(pids, matched_pages,
+        n_tail)`` (the ONLY varying part — it performs the device
+        copies), then insert the node and maintain the counters.
+        Returns ``(matched_pages, stored_pages)``.
+
+        Degrade contract: the cache is an optimization — a failed page
+        copy must not fail the finished request (or the scheduler loop,
+        on the freed-lane path), so a raising ``store`` returns the
+        not-yet-indexed pids to the free list (partially copied groups
+        are unreachable without a tree node, hence harmless) and reports
+        0 stored."""
+        want = self._pages_of(ids)
+        if not want:
+            return 0, 0
+        matched, path = self._match(ids, namespace)
+        self._clock += 1
+        for node, _n in path:
+            node.stamp = self._clock
+        if matched >= len(want):
+            return matched, 0              # fully cached already
+        tail = want[matched:]
+        # mark the match path busy: the tail's allocation may evict, and
+        # evicting (= unlinking) a path node would orphan the subtree
+        # this commit is about to attach to
+        self._busy.update(id(node) for node, _n in path)
+        try:
+            n = len(tail)
+            pids = self._alloc(n, span=span)
+            while pids is None and n > 1:
+                # degrade to the leading portion that fits (halving:
+                # O(log) alloc attempts, each of which may evict)
+                n //= 2
+                pids = self._alloc(n, span=span)
+        finally:
+            self._busy.clear()
+        if pids is None:
+            self.counters["store_skips"] += 1
+            return matched, 0
+        tail = tail[:n]
+        # attach point: deepest fully-matched node, splitting a
+        # partially-matched edge at its page boundary first
+        if path and path[-1][1] < len(path[-1][0].edge):
+            parent = self._split(path[-1][0], path[-1][1])
+        elif path:
+            parent = path[-1][0]
+        else:
+            parent = self._root_for(namespace)
+        try:
+            store(pids, matched, len(tail))
+        except Exception as e:  # noqa: BLE001 — skip the store (see the
+            # degrade contract in the docstring)
+            self._free.extend(pids)
+            self.counters["store_skips"] += 1
+            logger.warning("page store failed; commit skipped: %s", e)
+            return matched, 0
+        child = _Node(tail, pids, parent, namespace)
+        child.stamp = self._clock
+        parent.children[tail[0]] = child
+        self._ns_pages[namespace] = \
+            self._ns_pages.get(namespace, 0) + len(tail)
+        self.counters["stored_pages"] += len(tail)
+        return matched, len(tail)
 
     def _split(self, node: _Node, at: int) -> _Node:  # lfkt: holds[_lock]
         """Split ``node``'s edge after ``at`` pages; returns the new upper

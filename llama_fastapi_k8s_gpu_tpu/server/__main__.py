@@ -12,18 +12,24 @@ def main():
 
     # The reference scales with `gunicorn -w N` (reference
     # docker/Dockerfile.app:12).  On TPU that is the wrong axis: a chip
-    # admits ONE claimant process, and N workers would load N copies of
-    # the model.  The analogue is in-process lanes (LFKT_BATCH_SIZE, one
-    # weight-read serving up to B decode tokens) on one chip, and k8s
-    # `replicas` across chips (helm/values.yaml) — so any request for >1
-    # worker is refused loudly instead of silently serialized.
+    # admits ONE claimant process, and N interchangeable workers would
+    # load N copies of the model.  The principled axes are in-process
+    # lanes (LFKT_BATCH_SIZE) within one chip, ROLE-SPECIALIZED
+    # processes (LFKT_DISAGG_ROLE: a prefill tier streaming KV pages to
+    # decode replicas — serving/disagg/) across chips on one host, and
+    # k8s `replicas` across hosts — so any request for >1 worker is
+    # refused loudly instead of silently serialized.
     workers = knob("LFKT_WORKERS")
     if workers != 1:
         raise SystemExit(
             f"LFKT_WORKERS={workers} refused: one worker per process is "
             "load-bearing (a TPU chip admits a single claimant; the model "
-            "loads once per process). Scale concurrency with "
-            "LFKT_BATCH_SIZE lanes on one chip, or replicas across chips.")
+            "loads once per process). Scale within a chip with "
+            "LFKT_BATCH_SIZE lanes; scale across processes by ROLE, not "
+            "by copy — LFKT_DISAGG_ROLE=prefill|decode splits prefill "
+            "and decode into cooperating processes streaming KV pages "
+            "(docs/RUNBOOK.md 'Operating a split prefill/decode "
+            "fleet'); scale across chips with k8s replicas.")
     force_cpu_if_requested()   # site-hook defense (one copy: utils/config)
     host = knob("LFKT_HOST")
     port = knob("LFKT_PORT")

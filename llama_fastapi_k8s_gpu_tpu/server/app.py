@@ -175,6 +175,9 @@ def create_app(engine=None, settings: Settings | None = None,
     #: engine is loaded; the watchdog moves it between READY/DEGRADED/DEAD
     app.state.health = HealthMonitor()
     app.state.watchdog = None
+    #: disaggregated prefill/decode roles (serving/disagg/): armed at
+    #: startup from LFKT_DISAGG_ROLE; None = the single-process path
+    app.state.disagg = None
     app.state.engine_kw = {}   # which resilience kwargs the engine accepts
     # strong refs to fire-and-forget tasks: the loop holds only weak refs,
     # so an unreferenced task can be garbage-collected mid-flight (losing
@@ -784,6 +787,16 @@ def create_app(engine=None, settings: Settings | None = None,
         # (weakly held; obs/flightrec.py) — a later app wins, which is
         # exactly the live serving app
         _flightrec.FLIGHTREC.install(health=app.state.health, engine=engine)
+        # disaggregated prefill/decode (serving/disagg/): arm the page
+        # service and/or the remote-prefill client.  Misconfiguration
+        # (no paged pool, registry, missing peer) refuses startup loudly
+        # — the LFKT_WORKERS idiom — instead of serving half a fleet.
+        if settings.disagg_role != "off":
+            from ..serving.disagg import build_roles
+
+            app.state.disagg = build_roles(
+                settings.disagg_role, engine, settings,
+                metrics=app.state.metrics, health=app.state.health)
         app.state.ready = True
         app.state.health.transition(READY, "engine loaded")
         if settings.watchdog and getattr(engine, "heartbeat", None) is None \
@@ -817,6 +830,9 @@ def create_app(engine=None, settings: Settings | None = None,
         if app.state.watchdog is not None:
             app.state.watchdog.stop()
             app.state.watchdog = None
+        if app.state.disagg is not None:
+            app.state.disagg.close()
+            app.state.disagg = None
 
     def _enqueue_rd(request: Request, messages: list[dict],
                     extra: dict | None = None, *, model: str | None = None,
@@ -1225,7 +1241,7 @@ def create_app(engine=None, settings: Settings | None = None,
             # (engine/spec_auto.py) — operators verify the resolution here
             if getattr(eng, "spec_auto_decision", None) is not None:
                 engine_info["spec_auto"] = eng.spec_auto_decision
-        return {
+        doc = {
             "status": "ok",
             "state": st.health.state,
             "model_loaded": eng is not None,
@@ -1234,6 +1250,15 @@ def create_app(engine=None, settings: Settings | None = None,
             "engine": engine_info,
             "resilience": _resilience_info(),
         }
+        # disaggregated prefill/decode tier block (serving/disagg/): the
+        # role, the page service's counters, and — on the decode side —
+        # the peer state + the attributed reason pages stopped coming
+        # (docs/RUNBOOK.md "Operating a split prefill/decode fleet");
+        # absent on role=off pods, whose /health is byte-for-byte the
+        # pre-disagg document
+        if st.disagg is not None:
+            doc["disagg"] = st.disagg.status()
+        return doc
 
     @app.get("/metrics")
     async def metrics():
@@ -1316,6 +1341,12 @@ def create_app(engine=None, settings: Settings | None = None,
         if _flightrec.FLIGHTREC.armed:
             m.set_gauge("incidents_total",
                         _flightrec.FLIGHTREC.recorded_total)
+        # disagg wire liveness (the event counters — pages/bytes/
+        # fallbacks — are inc'd at event time by the roles via the sink)
+        dis = app.state.disagg
+        if dis is not None and dis.client is not None:
+            m.set_gauge("disagg_peer_connected",
+                        1.0 if dis.client.connected() else 0.0)
         tstats = app.state.tracer.stats()
         m.set_gauge("trace_ring_used", tstats["ring_used"])
         m.set_gauge("traces_started_total", tstats["started_total"])

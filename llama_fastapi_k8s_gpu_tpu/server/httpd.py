@@ -335,6 +335,13 @@ async def serve(app, host: str = "0.0.0.0", port: int = 8000,
             from ..utils.health import DRAINING
 
             health.transition(DRAINING, "shutdown signal received")
+        # a draining prefill tier stops admitting NEW page-wire peers
+        # (serving/disagg/): in-flight page transfers ride the drain like
+        # HTTP requests; decode replicas re-dial the Service and land on
+        # a live pod.  Full teardown happens in the app's shutdown hook.
+        disagg = getattr(getattr(app, "state", None), "disagg", None)
+        if disagg is not None and disagg.server is not None:
+            disagg.server.stop_accepting()
         server.close()            # stop accepting; existing tasks continue
         # one short tick before closing "idle" connections: a request whose
         # bytes are already buffered but whose handler is still parked in

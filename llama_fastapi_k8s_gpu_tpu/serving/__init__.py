@@ -1,4 +1,8 @@
-"""Multi-model, multi-tenant serving (docs/MULTIMODEL.md; ROADMAP item 5)."""
+"""Multi-model, multi-tenant serving (docs/MULTIMODEL.md; ROADMAP item 5)
+and the disaggregated prefill/decode subsystem (``serving/disagg/``,
+docs/RUNBOOK.md "Operating a split prefill/decode fleet" — imported
+lazily by server/app.py, never here: the page-wire CLI and wire-only
+consumers must not pay the registry's imports)."""
 
 from .manifest import OVERRIDE_KEYS, ModelSpec, parse_manifest, pick_default  # noqa: F401
 from .registry import ModelRegistry, UnknownModelError, WeightBudgetError  # noqa: F401

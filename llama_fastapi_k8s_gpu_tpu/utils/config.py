@@ -212,6 +212,31 @@ class Settings:
     # prefill, sharded-LSE decode), scaling max context linearly with the
     # ring size.  Serial serving (batch_size must stay 1).
     mesh_sp: int = 1
+    # -- disaggregated prefill/decode (serving/disagg/; docs/RUNBOOK.md
+    # "Operating a split prefill/decode fleet") ----------------------------
+    # role of this process in a split fleet: "off" (default — the single-
+    # process serving path, byte-for-byte unchanged), "prefill" (runs the
+    # KV-page service: prefills prompts and streams finished pages),
+    # "decode" (forwards admitted prompts to the prefill peer, restores
+    # the returned pages into its paged arena, decodes), or "both" (the
+    # in-process loopback: page service + client on one engine — the
+    # tier-1-testable / bench-A/B arm).  prefill/decode/both require
+    # LFKT_KV_PAGED=1: pages ARE the wire format.
+    disagg_role: str = "off"
+    # decode role: the prefill tier's page service, "host:port"
+    disagg_peer: str = ""
+    # prefill role: page-service bind address and port (0 = ephemeral,
+    # loopback/tests)
+    disagg_bind: str = "0.0.0.0"
+    disagg_port: int = 8470
+    # per-hop wire budget: a remote prefill that cannot complete within
+    # min(this, the request's remaining deadline) aborts on both sides and
+    # the decode replica falls back to LOCAL prefill with attribution
+    disagg_timeout_seconds: float = 5.0
+    # bounded page-frame send queue per peer connection (backpressure: a
+    # slow wire blocks the prefill tier's page export, never grows memory;
+    # the buffered bytes are the memory ledger's disagg_txbuf component)
+    disagg_queue_frames: int = 32
 
     @property
     def model_path(self) -> str:
@@ -330,6 +355,21 @@ KNOBS: dict[str, Knob] = _register(
     Knob("LFKT_SCHEDULER", str, "continuous|cycle batching flavor"),
     Knob("LFKT_MESH_TP", int, "tensor-parallel width"),
     Knob("LFKT_MESH_SP", int, "sequence-parallel ring size"),
+    # -- disaggregated prefill/decode (serving/disagg/) --------------------
+    Knob("LFKT_DISAGG_ROLE", str,
+         "off|prefill|decode|both — split prefill/decode fleet role "
+         "(serving/disagg/; requires LFKT_KV_PAGED=1 when not off)",
+         serving=True),
+    Knob("LFKT_DISAGG_PEER", str,
+         "decode role: prefill tier page service, host:port", serving=True),
+    Knob("LFKT_DISAGG_BIND", str, "prefill role: page-service bind address"),
+    Knob("LFKT_DISAGG_PORT", int,
+         "prefill role: page-service port (0 = ephemeral)", serving=True),
+    Knob("LFKT_DISAGG_TIMEOUT_SECONDS", float,
+         "per-hop wire budget before the decode side falls back to "
+         "local prefill"),
+    Knob("LFKT_DISAGG_QUEUE_FRAMES", int,
+         "bounded page-frame send queue per peer (backpressure)"),
     # -- ad-hoc knobs (read via knob()/env_bool(), not Settings) -----------
     Knob("LFKT_HOST", str, "bind address (server/__main__.py)",
          default="0.0.0.0"),

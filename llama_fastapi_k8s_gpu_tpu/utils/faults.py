@@ -39,8 +39,14 @@ import time
 logger = logging.getLogger(__name__)
 
 #: the valid injection-point names (typos in a spec must fail loudly at
-#: arm time, not silently never fire)
-POINTS = ("decode_step", "prefill", "load", "recover")
+#: arm time, not silently never fire).  The disagg points drive the
+#: split-fleet drills (serving/disagg/): ``slow_wire`` (slow mode) stalls
+#: a frame send, ``peer_dead`` (error mode) hard-closes the page stream
+#: mid-transfer, ``truncated_frame`` (error mode) ships a deliberately
+#: short frame then closes — each must leave the decode replica
+#: DEGRADED-but-serving via local-prefill fallback, never hung.
+POINTS = ("decode_step", "prefill", "load", "recover",
+          "peer_dead", "slow_wire", "truncated_frame")
 _MODES = ("error", "oom", "slow")
 
 

@@ -196,6 +196,33 @@ def test_bench_server_tiny_smoke():
     assert parsed["concurrent"]["agg_tok_s"] > 0
 
 
+def test_bench_server_disagg_smoke():
+    """The disagg arm (LFKT_BENCH_DISAGG=1): the two-role loopback run
+    must emit one valid JSON line where the split phase REALLY crossed
+    the page wire (remote prefills > 0, pages on the wire) next to a
+    role-off control phase of the same fresh-prompt workload — TTFT +
+    aggregate tok/s for both arms (serving/disagg/)."""
+    parsed, out = _run("bench_server.py",
+                       extra_env={"LFKT_BENCH_DISAGG": "1",
+                                  "LFKT_BENCH_N_REQ": "3",
+                                  "LFKT_BENCH_MAX_TOKENS": "12",
+                                  "LFKT_BENCH_PORT": "8045"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "disagg-loopback" in parsed["metric"]
+    assert parsed["value"] > 0                     # split-arm TTFT p50
+    for arm in ("control", "disagg"):
+        assert parsed[arm]["samples"] == 3, parsed[arm]
+        assert parsed[arm]["ttft_ms_p50"] > 0
+        assert parsed[arm]["gen_tokens"] > 0
+        assert parsed[arm]["agg_tok_s"] > 0
+    cli = parsed["disagg_client"]
+    assert cli["remote_prefills"] == 3, cli        # every split-arm prompt
+    assert cli["local_fallbacks"] == 0, cli        # ... hopped, cleanly
+    svc = parsed["disagg_service"]
+    assert svc["prefills_served"] == 3 and svc["pages_sent"] > 0, svc
+    assert svc["bytes_sent"] > 0
+
+
 def test_bench_server_batch_multiturn_smoke():
     """The lane-prefix A/B mode (LFKT_BENCH_MULTITURN x LFKT_BENCH_BATCH)
     must emit valid JSON with complete conversations and the engine-level

@@ -1,0 +1,561 @@
+"""Disaggregated prefill/decode (serving/disagg/; ISSUE 13).
+
+Three layers, all tier-1 on CPU:
+
+1. **In-process loopback** — a prefill-role engine and a decode-role
+   engine joined by the real TCP wire: greedy output bit-identical to
+   single-process ``LFKT_KV_PAGED=1`` serving, remote pages imported,
+   multi-turn warm traffic skipping the hop.
+2. **Fault drills** (utils/faults.py ``peer_dead`` / ``slow_wire`` /
+   ``truncated_frame``) — every wire condition degrades to LOCAL
+   prefill with attribution (fallback counters, health DEGRADED with a
+   ``disagg:`` reason, a flight-recorder bundle) and NEVER hangs or
+   fails a request; recovery restores READY without operator action.
+3. **Two-process drill** (the acceptance) — a ``LFKT_DISAGG_ROLE=
+   prefill`` server process streams pages to a ``role=decode`` server
+   process over loopback; greedy ``/response`` output is bit-identical
+   to the single-process paged engine, and SIGKILLing the prefill peer
+   leaves the decode replica DEGRADED-but-serving via local-prefill
+   fallback, attributed in ``/health`` and bundled by the flight
+   recorder.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.engine import ContinuousEngine, Engine
+from llama_fastapi_k8s_gpu_tpu.obs.flightrec import FlightRecorder
+from llama_fastapi_k8s_gpu_tpu.serving.disagg import ROLES, build_roles
+from llama_fastapi_k8s_gpu_tpu.serving.disagg.decoder import DisaggClient
+from llama_fastapi_k8s_gpu_tpu.serving.disagg.prefiller import PrefillServer
+from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+from llama_fastapi_k8s_gpu_tpu.utils.faults import FAULTS
+from llama_fastapi_k8s_gpu_tpu.utils.health import (
+    DEGRADED,
+    READY,
+    HealthMonitor,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FLIGHTREC_PATH = "llama_fastapi_k8s_gpu_tpu.obs.flightrec.FLIGHTREC"
+
+#: long enough that the whole-page prefix clears the serial paged-reuse
+#: constraints (page-aligned, >= prefix_min, suffix fits a smaller
+#: bucket) at page_tokens=16 / buckets (64, 128)
+MSG_A = ("The quick brown fox jumps over the lazy dog near the old "
+         "riverbank while autumn leaves drift slowly down, and then "
+         "some more words to pad this out nicely ok.")
+MSG_B = MSG_A.replace("fox", "cat").replace("autumn", "spring")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+@pytest.fixture(scope="module")
+def gguf_path(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("disagg") / "tiny.gguf")
+    write_tiny_llama_gguf(p)
+    return p
+
+
+def _engine(path, **kw):
+    base = dict(n_ctx=256, prefill_buckets=(64, 128), max_gen_tokens=8,
+                decode_chunk=4, kv_paged=True, kv_page_tokens=16)
+    base.update(kw)
+    return Engine(path, **base)
+
+
+def _greedy(eng, text=MSG_A, **kw):
+    out = eng.create_chat_completion(
+        [{"role": "user", "content": text}], temperature=0.0, **kw)
+    return out
+
+
+def _pair(gguf_path, health=None, timeout_s=60.0, recorder=None):
+    """(prefill_engine, decode_engine, server, client): two engines
+    joined by the real wire over loopback TCP."""
+    eng_p = _engine(gguf_path)
+    eng_d = _engine(gguf_path)
+    srv = PrefillServer(eng_p, host="127.0.0.1", port=0)
+    cli = DisaggClient(f"127.0.0.1:{srv.port}", eng_d._kvpool,
+                       timeout_s=timeout_s, health=health)
+    eng_d.install_disagg(cli)
+    return eng_p, eng_d, srv, cli
+
+
+# ---------------------------------------------------------------------------
+# layer 1: loopback parity + warm traffic
+# ---------------------------------------------------------------------------
+
+def test_loopback_bit_identity_and_remote_import(gguf_path):
+    """Remote-prefilled greedy output == local paged greedy output, the
+    pages genuinely crossed the wire, and the request's timings show the
+    restored prefix (the decode side served a reuse, not a re-prefill)."""
+    eng0 = _engine(gguf_path)
+    text0 = _greedy(eng0)["choices"][0]["message"]["content"]
+
+    eng_p, eng_d, srv, cli = _pair(gguf_path)
+    try:
+        out = _greedy(eng_d)
+        assert out["choices"][0]["message"]["content"] == text0
+        assert cli.counters["remote_prefills"] == 1
+        assert cli.counters["remote_tokens"] > 0
+        assert out["lfkt_timings"]["prefix_reused_tokens"] > 0
+        assert srv.counters["prefills_served"] == 1
+        assert srv.counters["pages_sent"] > 0
+        assert srv.counters["bytes_sent"] > 0
+        # the prefill tier committed the prefix to its own radix too —
+        # a second replica's identical request would export cache-warm
+        assert eng_p._kvpool.counters["stored_pages"] > 0
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_warm_multiturn_skips_the_hop(gguf_path):
+    """A restored prefix commits to the LOCAL radix, so the same
+    conversation's next request never pays the wire again."""
+    eng_p, eng_d, srv, cli = _pair(gguf_path)
+    try:
+        _greedy(eng_d)
+        assert cli.counters["remote_prefills"] == 1
+        _greedy(eng_d)
+        assert cli.counters["remote_prefills"] == 1    # no second hop
+        assert cli.counters["warm_local_skips"] >= 1
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_explicit_seed_bypasses_the_hop(gguf_path):
+    """Explicit seeds are a reproducibility request: like every reuse
+    path, remote prefill is skipped (the full local prefill serves)."""
+    eng_p, eng_d, srv, cli = _pair(gguf_path)
+    try:
+        out = _greedy(eng_d, seed=7)
+        assert isinstance(out["choices"][0]["message"]["content"], str)
+        assert cli.counters["remote_prefills"] == 0
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_continuous_scheduler_admission_hop(gguf_path):
+    """The continuous scheduler's admission path: the hop runs inside
+    _begin_admission, the imported pages restore via the admission's
+    paged reuse, and the completion matches the serial disagg output
+    (greedy paged parity across engines is already pinned — this pins
+    the REMOTE variant rides the same machinery)."""
+    eng0 = _engine(gguf_path)
+    text0 = _greedy(eng0)["choices"][0]["message"]["content"]
+
+    eng_p = _engine(gguf_path)
+    srv = PrefillServer(eng_p, host="127.0.0.1", port=0)
+    eng_d = ContinuousEngine(gguf_path, n_ctx=256,
+                             prefill_buckets=(64, 128), max_gen_tokens=8,
+                             decode_chunk=4, batch_size=2,
+                             prefill_chunk=16, kv_paged=True,
+                             kv_page_tokens=16)
+    cli = DisaggClient(f"127.0.0.1:{srv.port}", eng_d._kvpool,
+                       timeout_s=60.0)
+    eng_d.install_disagg(cli)
+    try:
+        out = eng_d.submit([{"role": "user", "content": MSG_A}],
+                           temperature=0.0).result(timeout=300)
+        assert out["choices"][0]["message"]["content"] == text0
+        assert cli.counters["remote_prefills"] == 1
+        stats = eng_d.scheduler_stats()
+        assert stats["radix_prefix_hits"] >= 1
+        assert stats["radix_prefix_reused_tokens"] > 0
+    finally:
+        cli.close()
+        srv.stop()
+        eng_d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# layer 2: fault drills — degrade with attribution, never hang
+# ---------------------------------------------------------------------------
+
+def test_peer_dead_midstream_falls_back_degrades_and_recovers(
+        gguf_path, tmp_path, monkeypatch):
+    """The acceptance degrade path, in process: the peer dies mid page
+    stream → the request still answers (local prefill), the fallback is
+    attributed (counter + DEGRADED reason + flight-recorder bundle),
+    and the next successful hop restores READY."""
+    rec = FlightRecorder(directory=str(tmp_path / "inc"), ring=4,
+                         debounce_s=0.0, log_lines=20)
+    monkeypatch.setattr(FLIGHTREC_PATH, rec)
+    health = HealthMonitor()
+    health.transition(READY, "test")
+    eng_p, eng_d, srv, cli = _pair(gguf_path, health=health)
+    try:
+        eng0 = _engine(gguf_path)
+        text0 = _greedy(eng0)["choices"][0]["message"]["content"]
+
+        # the prefill handler hard-closes between PAGE groups
+        FAULTS.arm("peer_dead:error:times=1")
+        out = _greedy(eng_d)
+        assert out["choices"][0]["message"]["content"] == text0
+        assert cli.counters["local_fallbacks"] >= 1
+        assert cli.counters["remote_prefills"] == 0
+        snap = health.snapshot()
+        assert snap["state"] == DEGRADED
+        assert snap["reason"].startswith("disagg:")
+        assert "local-prefill fallback" in snap["reason"]
+        assert rec.recorded_total == 1
+        bundle = rec.get(rec.list()[0]["id"])
+        assert bundle["kind"] == "disagg_peer_dead"
+        assert cli.peer in bundle["reason"]
+
+        # recovery: the wire is healthy again; after the reconnect
+        # backoff the next FRESH prompt hops successfully and READY is
+        # restored without operator action
+        FAULTS.disarm()
+        time.sleep(1.3)          # > the first reconnect backoff (1 s)
+        out2 = _greedy(eng_d, text=MSG_B)
+        assert isinstance(out2["choices"][0]["message"]["content"], str)
+        assert cli.counters["remote_prefills"] >= 1
+        assert health.snapshot()["state"] == READY
+        assert "restored" in health.snapshot()["reason"]
+    finally:
+        cli.close()
+        srv.stop()
+        rec.configure(directory="")
+
+
+def test_truncated_frame_rejected_nothing_imported(gguf_path):
+    """A torn PAGE frame must degrade to local prefill AND leave no
+    partial prefix in the decode pool's radix (plausible-looking partial
+    KV is the corruption this wire exists to refuse)."""
+    eng_p, eng_d, srv, cli = _pair(gguf_path)
+    try:
+        # sends: HELLO(1) HELLO_OK(2) REQ(3), then the first PAGE frame
+        # (4) ships torn
+        FAULTS.arm("truncated_frame:error:after=3:times=1")
+        out = _greedy(eng_d)
+        assert isinstance(out["choices"][0]["message"]["content"], str)
+        assert cli.counters["local_fallbacks"] >= 1
+        assert cli.counters["remote_prefills"] == 0
+        # the torn transfer imported NOTHING into the radix (the local
+        # serve's own commit is the only content the pool may hold)
+        assert eng_d._kvpool.counters["imported_pages"] == 0
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_slow_wire_hits_the_hop_budget_and_falls_back(gguf_path):
+    """A wire slower than the hop budget times out into local prefill —
+    bounded, attributed, request still served."""
+    eng_p, eng_d, srv, cli = _pair(gguf_path, timeout_s=1.0)
+    try:
+        FAULTS.arm("slow_wire:slow:delay=1.5:times=0")
+        t0 = time.time()
+        out = _greedy(eng_d)
+        assert isinstance(out["choices"][0]["message"]["content"], str)
+        assert cli.counters["remote_prefills"] == 0
+        assert cli.counters["local_fallbacks"] >= 1
+        # bounded: a few injected sleeps + the local serve, never a hang
+        assert time.time() - t0 < 30
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_geometry_mismatch_refuses_permanently_with_attribution(
+        gguf_path):
+    """An int8-KV prefill tier cannot feed a bf16 decode replica: the
+    handshake refuses with attribution, the refusal is permanent (no
+    reconnect hammering), and the replica keeps serving locally."""
+    eng_p = _engine(gguf_path, kv_dtype="int8")
+    eng_d = _engine(gguf_path)              # bf16 layout
+    srv = PrefillServer(eng_p, host="127.0.0.1", port=0)
+    cli = DisaggClient(f"127.0.0.1:{srv.port}", eng_d._kvpool,
+                       timeout_s=60.0)
+    eng_d.install_disagg(cli)
+    try:
+        out = _greedy(eng_d)
+        assert isinstance(out["choices"][0]["message"]["content"], str)
+        assert cli._refused is not None
+        assert "geometry mismatch" in cli._refused
+        assert srv.counters["handshake_refusals"] == 1
+        # permanent: the next request never redials
+        _greedy(eng_d, text=MSG_B)
+        assert cli.counters["reconnects"] == 0
+        assert srv.counters["peers_total"] == 1
+    finally:
+        cli.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# role wiring + the off-path pin
+# ---------------------------------------------------------------------------
+
+def test_role_off_is_one_attribute_read(gguf_path, monkeypatch):
+    """LFKT_DISAGG_ROLE=off (the default): the admission path reads ONE
+    attribute (``_disagg is None``) — pinned by poisoning every client
+    entry point and serving anyway."""
+    eng = _engine(gguf_path)
+    assert eng._disagg is None
+
+    def _poison(*a, **kw):
+        raise AssertionError("role=off path touched the disagg client")
+
+    monkeypatch.setattr(Engine, "_remote_prefill", _poison)
+    monkeypatch.setattr(Engine, "_remote_prefill_ids", _poison)
+    monkeypatch.setattr(DisaggClient, "prefetch", _poison)
+    out = _greedy(eng)
+    assert isinstance(out["choices"][0]["message"]["content"], str)
+
+
+def test_build_roles_validation(gguf_path):
+    from llama_fastapi_k8s_gpu_tpu.utils.config import Settings
+
+    settings = Settings()
+    assert build_roles("off", object(), settings) is None
+    with pytest.raises(ValueError, match="must be one of"):
+        build_roles("sideways", object(), settings)
+    # a dense-ring engine cannot speak the page wire
+    dense = Engine(gguf_path, n_ctx=256, prefill_buckets=(64, 128),
+                   max_gen_tokens=8, kv_paged=False)
+    with pytest.raises(ValueError, match="LFKT_KV_PAGED"):
+        build_roles("decode", dense, settings)
+    # decode role without a peer address
+    paged = _engine(gguf_path)
+    with pytest.raises(ValueError, match="LFKT_DISAGG_PEER"):
+        build_roles("decode", paged, settings)
+
+    # a registry-shaped engine gates off with attribution
+    class _Registry:
+        def models(self):
+            return []
+    with pytest.raises(ValueError, match="multi-model"):
+        build_roles("prefill", _Registry(), settings)
+    assert ROLES == ("off", "prefill", "decode", "both")
+
+
+def test_both_role_loopback_on_one_engine(gguf_path):
+    """role=both: page service + client on ONE engine — the tier-1 /
+    bench configuration.  The wire is genuinely crossed (pages serialize
+    through TCP) even though import then dedupes against the same pool."""
+    from llama_fastapi_k8s_gpu_tpu.utils.config import Settings
+
+    eng = _engine(gguf_path)
+    roles = build_roles("both", eng, Settings(
+        disagg_timeout_seconds=60.0))
+    try:
+        assert roles.role == "both"
+        assert roles.server is not None and roles.client is not None
+        assert eng._disagg is roles.client
+        out = _greedy(eng)
+        assert isinstance(out["choices"][0]["message"]["content"], str)
+        assert roles.server.counters["prefills_served"] == 1
+        assert roles.server.counters["pages_sent"] > 0
+        status = roles.status()
+        assert status["role"] == "both"
+        assert status["prefill_service"]["pages_sent"] > 0
+        assert status["peer"]["peer"].startswith("127.0.0.1:")
+    finally:
+        roles.close()
+
+
+# ---------------------------------------------------------------------------
+# layer 3: the two-process acceptance drill
+# ---------------------------------------------------------------------------
+
+def _proc_env(port: int, model_dir: str, incident_dir: str | None = None,
+              **extra) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "LFKT_MODEL_DIR": model_dir,
+        "LFKT_MODEL_NAME": "tiny.gguf",
+        "LFKT_HOST": "127.0.0.1",
+        "LFKT_PORT": str(port),
+        "LFKT_PREFILL_BUCKETS": "64,128",
+        "LFKT_MAX_GEN_TOKENS": "8",
+        "LFKT_DECODE_CHUNK": "4",
+        "LFKT_TEMPERATURE": "0.0",
+        "LFKT_KV_PAGED": "1",
+        "LFKT_KV_PAGE_TOKENS": "16",
+        "LFKT_DISAGG_TIMEOUT_SECONDS": "60",
+    })
+    if incident_dir is not None:
+        env["LFKT_INCIDENT_DIR"] = incident_dir
+        env["LFKT_INCIDENT_DEBOUNCE_S"] = "0"
+    env.update({k: str(v) for k, v in extra.items()})
+    env.pop("XLA_FLAGS", None)   # one CPU device per serving replica
+    return env
+
+
+def _wait_ready(proc, port: int, deadline: float) -> None:
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"server :{port} died:\n"
+                f"{proc.stderr.read().decode()[-3000:]}")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=5) as r:
+                if r.status == 200:
+                    return
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(1.0)
+    raise AssertionError(f"server :{port} not healthy before deadline")
+
+
+def _body(message: str) -> bytes:
+    return json.dumps({
+        "bot_profile": {
+            "name": "Ada",
+            "appearance": "tall, green eyes, red hair, calm voice",
+            "system_prompt": "You are a concise assistant.",
+        },
+        "user_profile": {"name": "Sam"},
+        "context": [{"turn": "user", "message": message}],
+    }).encode()
+
+
+def _post(port: int, body: bytes) -> str:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/response", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read())["response"]
+
+
+def _get_json(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _metric(port: int, name: str) -> float:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    total = 0.0
+    found = False
+    for ln in text.splitlines():
+        if ln.startswith(name) and " " in ln:
+            head, _, val = ln.rpartition(" ")
+            if head == name or head.startswith(name + "{"):
+                total += float(val)
+                found = True
+    return total if found else -1.0
+
+
+def test_two_process_page_streaming_drill(tmp_path):
+    """THE acceptance drill: a prefill-role process streams KV pages to
+    a decode-role process over loopback TCP; greedy /response output is
+    bit-identical to single-process LFKT_KV_PAGED=1 serving; killing
+    the prefill peer leaves the decode replica DEGRADED-but-serving via
+    local-prefill fallback, attributed in /health, with a
+    flight-recorder bundle."""
+    write_tiny_llama_gguf(str(tmp_path / "tiny.gguf"))
+    inc_dir = str(tmp_path / "incidents")
+    http_p, http_d, dport = 8061, 8062, 8463
+
+    # single-process paged baseline, computed in-process with the exact
+    # messages + sampling the server assembles (build_system_prompt +
+    # truncation + the pod's serving defaults at LFKT_TEMPERATURE=0 —
+    # greedy, so cross-process determinism holds: the golden-transcript
+    # precedent in tests/test_multiproc.py)
+    from llama_fastapi_k8s_gpu_tpu.server.app import (
+        build_system_prompt,
+        truncate_messages_to_fit_context,
+    )
+    from llama_fastapi_k8s_gpu_tpu.server.schemas import BotProfile
+
+    profile = BotProfile(
+        name="Ada", appearance="tall, green eyes, red hair, calm voice",
+        system_prompt="You are a concise assistant.")
+    messages = [{"role": "user", "content": MSG_A}]
+    messages.insert(1, {"role": "system",
+                        "content": build_system_prompt(profile)})
+    messages = truncate_messages_to_fit_context(messages, 1024)
+    eng0 = Engine(str(tmp_path / "tiny.gguf"), n_ctx=1024,
+                  prefill_buckets=(64, 128), max_gen_tokens=8,
+                  decode_chunk=4, kv_paged=True, kv_page_tokens=16)
+    text0 = eng0.create_chat_completion(
+        messages, temperature=0.0, top_p=0.9, frequency_penalty=0.7,
+        presence_penalty=0.8)["choices"][0]["message"]["content"]
+
+    proc_p = subprocess.Popen(
+        [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.server"],
+        env=_proc_env(http_p, str(tmp_path), LFKT_DISAGG_ROLE="prefill",
+                      LFKT_DISAGG_BIND="127.0.0.1",
+                      LFKT_DISAGG_PORT=dport),
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    proc_d = subprocess.Popen(
+        [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.server"],
+        env=_proc_env(http_d, str(tmp_path), incident_dir=inc_dir,
+                      LFKT_DISAGG_ROLE="decode",
+                      LFKT_DISAGG_PEER=f"127.0.0.1:{dport}"),
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 420
+        _wait_ready(proc_p, http_p, deadline)
+        _wait_ready(proc_d, http_d, deadline)
+
+        # cold request through the decode replica: pages stream from the
+        # prefill process, greedy output is BIT-identical to the
+        # single-process paged engine
+        assert _post(http_d, _body(MSG_A)) == text0
+        assert _metric(http_d, "disagg_remote_prefills_total") >= 1
+        assert _metric(http_d, "disagg_pages_received_total") >= 1
+        health = _get_json(http_d, "/health")
+        assert health["disagg"]["role"] == "decode"
+        assert health["disagg"]["peer"]["connected"] is True
+        # the prefill tier's own surfaces saw the transfer
+        p_health = _get_json(http_p, "/health")
+        assert p_health["disagg"]["role"] == "prefill"
+        assert p_health["disagg"]["prefill_service"]["pages_sent"] >= 1
+
+        # kill the prefill peer: the decode replica must keep SERVING
+        # (local-prefill fallback) while attributing the loss
+        proc_p.send_signal(signal.SIGKILL)
+        proc_p.wait(timeout=30)
+        out2 = _post(http_d, _body(MSG_B))      # fresh prompt: must hop
+        assert isinstance(out2, str)
+        assert _metric(http_d, "disagg_local_fallbacks_total") >= 1
+        health = _get_json(http_d, "/health")
+        assert health["state"] == "DEGRADED"
+        reason = health["resilience"]["health"]["reason"]
+        assert reason.startswith("disagg:")
+        assert "local-prefill fallback" in reason
+        assert health["disagg"]["peer"]["connected"] is False
+        assert health["disagg"]["peer"]["local_fallbacks"] >= 1
+        # ... and the flight recorder bundled the death
+        incidents = _get_json(http_d, "/debug/incidents")
+        assert incidents["armed"] is True
+        assert incidents["recorded_total"] >= 1
+        assert any(i["kind"] == "disagg_peer_dead"
+                   for i in incidents["incidents"])
+    finally:
+        for p in (proc_p, proc_d):
+            if p.poll() is None:
+                p.terminate()
+        for p in (proc_p, proc_d):
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -1,0 +1,172 @@
+"""Disagg page wire format (serving/disagg/wire.py): bitwise round
+trips for both cache layouts, handshake refusals with attribution,
+truncated-frame rejection, and the committed golden schema header
+(tools/ci_gate.py's ``disagg-wire-schema`` check, pinned here too so
+tier-1 catches the drift before the gate does)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.parallel.kvpool import KVPool
+from llama_fastapi_k8s_gpu_tpu.serving.disagg import wire
+from llama_fastapi_k8s_gpu_tpu.serving.disagg.transport import FrameConn
+from llama_fastapi_k8s_gpu_tpu.testing import TINY_CFG
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pool(kv_dtype: str) -> KVPool:
+    cfg = dataclasses.replace(TINY_CFG, kv_dtype=kv_dtype)
+    return KVPool(cfg, page_tokens=16, n_pages=8)
+
+
+def _random_leaves(geometry: dict, n_pages: int, seed: int = 0) -> list:
+    """Random page stacks matching a pool geometry, built from raw bytes
+    so every dtype (incl. bfloat16) gets arbitrary bit patterns — the
+    round trip must preserve BITS, not float values."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for leaf, size in zip(geometry["leaves"], wire.leaf_nbytes(geometry)):
+        raw = rng.integers(0, 256, size=n_pages * size,
+                           dtype=np.uint8).tobytes()
+        dt = wire._np_dtype(leaf["dtype"])
+        out.append(np.frombuffer(raw, dtype=dt).reshape(
+            (n_pages,) + tuple(leaf["shape"])))
+    return out
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_page_payload_bitwise_round_trip(kv_dtype):
+    """serialize → deserialize is BIT-identical for both page layouts
+    (the bf16 {k,v} pair and the int8 four-leaf layout whose scales ride
+    the page), and the leaf count/shapes/dtypes survive."""
+    pool = _pool(kv_dtype)
+    geo = wire.pool_geometry(pool)
+    n_leaves = 2 if kv_dtype == "bf16" else 4
+    assert len(geo["leaves"]) == n_leaves
+    leaves = _random_leaves(geo, n_pages=3)
+    payload = wire.encode_pages(leaves)
+    assert len(payload) == 3 * sum(wire.leaf_nbytes(geo))
+    back = wire.decode_pages(payload, 3, geo)
+    assert len(back) == len(leaves)
+    for a, b in zip(leaves, back):
+        assert a.shape == b.shape
+        assert a.dtype == b.dtype
+        assert a.tobytes() == b.tobytes()      # bitwise, not allclose
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_page_frame_round_trip_through_frames(kv_dtype):
+    """The full frame path: encode_frame → decode_frame → decode_pages,
+    header intact, payload bitwise."""
+    pool = _pool(kv_dtype)
+    geo = wire.pool_geometry(pool)
+    leaves = _random_leaves(geo, n_pages=2, seed=7)
+    frame = wire.encode_frame(wire.FRAME_PAGE,
+                              {"rid": 9, "seq": 0, "n_pages": 2},
+                              wire.encode_pages(leaves))
+    ftype, hdr, payload = wire.decode_frame(frame[4:])  # strip length
+    assert ftype == wire.FRAME_PAGE
+    assert hdr == {"rid": 9, "seq": 0, "n_pages": 2}
+    back = wire.decode_pages(payload, 2, geo)
+    for a, b in zip(leaves, back):
+        assert a.tobytes() == b.tobytes()
+
+
+def test_schema_version_mismatch_refuses_with_attribution():
+    pool = _pool("bf16")
+    mine = wire.pool_geometry(pool)
+    theirs = dict(mine, wire_schema=wire.WIRE_SCHEMA + 1)
+    msg = wire.geometry_mismatch(mine, theirs)
+    assert msg is not None
+    assert "wire schema mismatch" in msg
+    assert str(wire.WIRE_SCHEMA) in msg
+    assert "upgrade" in msg                    # names the fix
+
+
+def test_geometry_mismatch_refuses_with_attribution():
+    """Different kv_dtype (leaf layout) and different page size must both
+    refuse, naming the differing field — two pools that cannot exchange
+    pages bit-exactly never try."""
+    bf16 = wire.pool_geometry(_pool("bf16"))
+    int8 = wire.pool_geometry(_pool("int8"))
+    msg = wire.geometry_mismatch(bf16, int8)
+    assert msg is not None and "leaves" in msg
+    other = dict(bf16, page_tokens=32)
+    msg = wire.geometry_mismatch(bf16, other)
+    assert msg is not None and "page_tokens" in msg
+    # and identical geometry passes
+    assert wire.geometry_mismatch(bf16, json.loads(json.dumps(bf16))) is None
+
+
+def test_truncated_frames_are_rejected():
+    """Every truncation point is a hard WireError: short header, short
+    JSON, short page payload — never plausible-looking partial KV."""
+    pool = _pool("int8")
+    geo = wire.pool_geometry(pool)
+    leaves = _random_leaves(geo, n_pages=1)
+    frame = wire.encode_frame(wire.FRAME_PAGE,
+                              {"rid": 1, "seq": 0, "n_pages": 1},
+                              wire.encode_pages(leaves))[4:]
+    with pytest.raises(wire.WireError):
+        wire.decode_frame(frame[:3])           # inside the type/hlen head
+    with pytest.raises(wire.WireError):
+        wire.decode_frame(frame[:10])          # inside the JSON header
+    ftype, hdr, payload = wire.decode_frame(frame)
+    with pytest.raises(wire.WireError):
+        wire.decode_pages(payload[:-5], 1, geo)   # short payload
+    with pytest.raises(wire.WireError):
+        wire.decode_pages(payload + b"x", 1, geo)  # padded payload
+    with pytest.raises(wire.WireError):
+        wire.decode_frame(b"\x63" + frame[1:])     # unknown frame type
+
+
+def test_frame_conn_rejects_torn_wire():
+    """A peer that dies mid-frame surfaces as WireError on the reader —
+    the transport never hands partial frames up."""
+    a, b = socket.socketpair()
+    try:
+        conn = FrameConn(b)
+        conn.settimeout(5.0)
+        full = wire.encode_frame(wire.FRAME_DONE,
+                                 {"rid": 1, "tokens": 0, "n_pages": 0,
+                                  "first_token": None})
+        a.sendall(full[: len(full) // 2])
+        a.close()
+        with pytest.raises(wire.WireError):
+            conn.recv_frame()
+    finally:
+        b.close()
+
+
+def test_oversized_length_prefix_is_rejected():
+    a, b = socket.socketpair()
+    try:
+        conn = FrameConn(b)
+        conn.settimeout(5.0)
+        a.sendall((wire.MAX_FRAME + 1).to_bytes(4, "big"))
+        with pytest.raises(wire.WireError):
+            conn.recv_frame()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_schema_golden_header_is_pinned():
+    """The committed golden (docs/disagg_wire_schema.json) must match the
+    live descriptor byte-for-byte — the ci_gate check's tier-1 twin.  A
+    deliberate format change bumps WIRE_SCHEMA and regenerates the
+    golden (`python -m ...serving.disagg.wire --schema`)."""
+    golden = open(os.path.join(REPO, "docs", "disagg_wire_schema.json"),
+                  encoding="utf-8").read()
+    assert golden == wire.canonical_schema_json(), (
+        "disagg wire schema drifted from docs/disagg_wire_schema.json — "
+        "bump WIRE_SCHEMA and regenerate the golden deliberately")
+    assert wire.schema_descriptor()["wire_schema"] == wire.WIRE_SCHEMA

@@ -448,4 +448,5 @@ def test_disarmed_recorder_is_poison_proof(monkeypatch):
 def test_kinds_are_closed_set(recorder):
     assert recorder.record("made_up_kind", "r") is None
     assert set(KINDS) == {"watchdog_trip", "dead_escalation",
-                          "resource_exhausted", "slo_breach"}
+                          "resource_exhausted", "slo_breach",
+                          "disagg_peer_dead"}

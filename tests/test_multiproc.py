@@ -8,6 +8,10 @@ different and deliberate:
   batching) — N worker processes would load N model copies and fight over
   the chip's single claimant slot, so `LFKT_WORKERS>1` is REFUSED
   (server/__main__.py), pinned here;
+- across processes: by ROLE, not by copy — `LFKT_DISAGG_ROLE` splits
+  prefill and decode into cooperating processes streaming KV pages
+  (serving/disagg/; drilled in tests/test_disagg.py), which the refusal
+  message now names as the principled multi-process path;
 - across chips: k8s `replicas` of the 1-worker pod (helm/values.yaml) —
   the two-replica analogue is smoke-tested here as two real server
   processes on one host, each with its own engine, both serving the
@@ -60,7 +64,10 @@ def test_multi_worker_request_is_refused():
         cwd=REPO, capture_output=True, text=True, timeout=60)
     assert proc.returncode != 0
     assert "LFKT_WORKERS=2 refused" in proc.stderr
-    assert "LFKT_BATCH_SIZE" in proc.stderr      # points at the right axis
+    assert "LFKT_BATCH_SIZE" in proc.stderr      # points at the right axes:
+    assert "LFKT_DISAGG_ROLE" in proc.stderr     # lanes within a chip, roles
+    assert "replicas" in proc.stderr             # across processes, replicas
+    #                                              across chips
 
 
 def test_two_replica_processes_serve_concurrently(tmp_path):

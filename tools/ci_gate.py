@@ -35,6 +35,13 @@ CHECKS: list[tuple[str, list[str]]] = [
     ("incident-schema", [sys.executable,
                          os.path.join(ROOT, "tools", "incident_report.py"),
                          "--validate"]),
+    # the disagg page-wire format (serving/disagg/wire.py) is pinned
+    # against a committed golden header: a drive-by edit that would
+    # strand a mixed-version prefill/decode fleet fails here until
+    # WIRE_SCHEMA is bumped and the golden regenerated deliberately
+    ("disagg-wire-schema", [sys.executable, "-m",
+                            "llama_fastapi_k8s_gpu_tpu.serving.disagg.wire",
+                            "--check-golden"]),
     # layer-looped decode bit-exactness (ISSUE 12): the serial-engine
     # greedy-parity subset of tests/test_decode_loop.py, standalone —
     # greedy output with LFKT_DECODE_LAYER_UNROLL armed must stay

@@ -133,6 +133,16 @@ def render_trace(trace: dict) -> str:
                     suffix += f" {_fmt_bytes(ev['bytes'])}"
                 duration_bar(at, host_s, "░", ev["name"], suffix)
                 continue
+            if ev["name"] == "disagg_recv" and host_s is not None:
+                # disaggregated prefill (▓, serving/disagg/): one page
+                # transfer over the wire, rendered DMA-style — the hop's
+                # cost next to the local restore/suffix-prefill it buys
+                suffix = (f"pages={ev.get('pages', '?')}"
+                          f" t={ev.get('tokens', '?')}")
+                if ev.get("bytes") is not None:
+                    suffix += f" {_fmt_bytes(ev['bytes'])}"
+                duration_bar(at, host_s, "▓", ev["name"], suffix)
+                continue
             mark = min(int(at / total * WIDTH), WIDTH - 1)
             tick = " " * mark + "▲" + " " * (WIDTH - mark - 1)
             ename = (" " * ((depth + 1) * INDENT) + "* " + ev["name"])[:NAME_COL]
