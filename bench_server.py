@@ -137,6 +137,16 @@ def main() -> None:
         raise SystemExit(
             "LFKT_BENCH_MIXED_MODELS=1 needs LFKT_BENCH_BATCH>1: the arm "
             "measures models interleaving across scheduler lanes")
+    # fleet arm (serving/fleet/): TWO in-process serial paged replicas
+    # behind the prefix-affinity router, multi-turn replay affinity-on vs
+    # the round-robin control — the hit-ratio/warm-TTFT answer to "does
+    # the router actually keep conversations on their warm replica"
+    fleet_arm = os.environ.get("LFKT_BENCH_FLEET") == "1"
+    if fleet_arm and (batch > 1 or mixed_models or disagg_arm or multiturn):
+        raise SystemExit(
+            "LFKT_BENCH_FLEET=1 is its own arm (two serial paged replicas "
+            "behind the router): drop LFKT_BENCH_BATCH/MULTITURN/"
+            "MIXED_MODELS/DISAGG")
     # the app sizes its in-flight permit pool from settings.batch_size
     # (server/app.py: Semaphore(max(1, settings.batch_size))) — without
     # this the server serializes requests at inflight=1 and a B-lane
@@ -147,6 +157,228 @@ def main() -> None:
     from llama_fastapi_k8s_gpu_tpu.utils.config import Settings, get_settings
 
     settings = get_settings()
+
+    if fleet_arm:
+        # LFKT_BENCH_FLEET=1: two replicas (serial paged engines, same
+        # synthetic weights — bit-identical greedy twins) each behind a
+        # real httpd, fronted by a real FleetRouter; C conversations x T
+        # turns replayed round-robin ACROSS conversations, so
+        # consecutive requests belong to different conversations (the
+        # k8s traffic shape).  Phase A routes policy=affinity, phase B
+        # (fresh replicas: counters and radix trees start cold) routes
+        # the identical replay policy=roundrobin.  Reported per phase:
+        # the aggregate token-weighted prefix hit ratio
+        # (prefix_cache_reused_tokens_total / tokens_prompt_total across
+        # both replicas — the fraction of prompt tokens served from
+        # cached KV pages) and warm (turn>=2) streamed TTFT p50.  C is
+        # ODD on purpose: with 2 replicas an even C makes round-robin
+        # accidentally affine ((t*C+c) mod 2 == c mod 2), flattering the
+        # control.
+        from llama_fastapi_k8s_gpu_tpu.serving.fleet.peers import PeerTable
+        from llama_fastapi_k8s_gpu_tpu.serving.fleet.router import (
+            FleetRouter,
+        )
+
+        convs = int(os.environ.get("LFKT_BENCH_CONVS", "3"))
+        if convs % 2 == 0:
+            convs += 1
+        turns = max(2, int(os.environ.get("LFKT_BENCH_TURNS", "3")))
+        page_tokens = (16 if preset == "tiny"
+                       else settings.kv_page_tokens)
+        pq = lambda v, q: v[min(len(v) - 1, int(q * len(v)))]  # noqa: E731
+
+        def wait_http(url: str, deadline_s: float = 120.0) -> None:
+            deadline = time.time() + deadline_s
+            while True:
+                try:
+                    urllib.request.urlopen(url, timeout=5)
+                    return
+                except Exception:  # noqa: BLE001 — booting
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.2)
+
+        def start_replica(rport: int):
+            reng = Engine.from_parts(
+                params, cfg, tok, template_kind="llama3",
+                max_gen_tokens=max_tokens, attn_impl=cfg.attn_impl,
+                decode_chunk=settings.decode_chunk,
+                prefill_chunk=settings.prefill_chunk,
+                kv_paged=True, kv_page_tokens=page_tokens)
+            reng.warmup()
+            rapp = create_app(engine=reng)
+            threading.Thread(
+                target=lambda: asyncio.run(
+                    httpd.serve(rapp, host="127.0.0.1", port=rport)),
+                daemon=True).start()
+            wait_http(f"http://127.0.0.1:{rport}/health")
+            return reng
+
+        def start_router(rport: int, peer_ports: list, policy: str):
+            table = PeerTable(
+                peers=[f"127.0.0.1:{p}" for p in peer_ports],
+                probe_seconds=1.0).start()
+            router = FleetRouter(table, policy=policy)
+            threading.Thread(
+                target=lambda: asyncio.run(
+                    router.serve("127.0.0.1", rport)),
+                daemon=True).start()
+            wait_http(f"http://127.0.0.1:{rport}/health/ready")
+            return router
+
+        def fleet_stream_ttft(rport: int, body: bytes):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rport}/response/stream", data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            first, err, parts = None, None, []
+            with urllib.request.urlopen(req, timeout=600) as r:
+                for raw in r:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data:"):
+                        continue
+                    body_ln = line[5:].strip()
+                    if body_ln == "[DONE]":
+                        break
+                    evt = json.loads(body_ln)
+                    if "error" in evt:
+                        err = str(evt["error"])
+                        break
+                    c = evt["choices"][0]["delta"].get("content")
+                    if c:
+                        if first is None:
+                            first = (time.perf_counter() - t0) * 1e3
+                        parts.append(c)
+            if first is None:
+                first = (time.perf_counter() - t0) * 1e3
+            return first, "".join(parts), err
+
+        def replica_metric(rport: int, name: str) -> float:
+            """Sum of one family's series (labeled or not) on a replica."""
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rport}/metrics", timeout=30) as r:
+                text = r.read().decode()
+            total = 0.0
+            for ln in text.splitlines():
+                head, _, val = ln.rpartition(" ")
+                if head == name or head.startswith(name + "{"):
+                    total += float(val)
+            return total
+
+        def fleet_payload(c: int, history: list) -> bytes:
+            # distinct persona + opener per conversation: affinity keys
+            # differ AND the radix shares nothing across conversations,
+            # so reuse measured here is conversation affinity, not the
+            # shared-system-prompt effect PR 6 already banked
+            return json.dumps({
+                "bot_profile": {
+                    "name": f"Bot{c}",
+                    "appearance": "tall, green eyes, red hair, calm voice",
+                    "system_prompt": f"You are concise assistant #{c} "
+                                     "who answers briefly.",
+                },
+                "user_profile": {"name": "Sam"},
+                "context": history,
+            }).encode()
+
+        followups = [
+            "Interesting, tell me more.", "Why is that?", "Go on.",
+            "What happened next?", "Could you expand on that?",
+        ]
+
+        def fleet_phase(policy: str, base_port: int) -> dict:
+            p1, p2 = base_port + 1, base_port + 2
+            start_replica(p1)
+            start_replica(p2)
+            router = start_router(base_port, [p1, p2], policy)
+            histories = {
+                c: [{"turn": "user",
+                     "message": f"Hello bot {c}! Please introduce "
+                                "yourself briefly and tell me a story."}]
+                for c in range(convs)
+            }
+            warm, turn1, errors = [], [], []
+            t0p = time.perf_counter()
+            for t in range(turns):
+                for c in range(convs):
+                    body = fleet_payload(c, histories[c])
+                    try:
+                        ms, text, err = fleet_stream_ttft(base_port, body)
+                    except Exception as e:  # noqa: BLE001 — transport
+                        errors.append(f"{type(e).__name__}: {e}")
+                        continue
+                    if err is not None:
+                        errors.append(err)
+                        continue
+                    (turn1 if t == 0 else warm).append(ms)
+                    histories[c].append(
+                        {"turn": "bot", "message": (text or "...")[:400]})
+                    histories[c].append(
+                        {"turn": "user",
+                         "message": followups[(c + t) % len(followups)]})
+            wall = time.perf_counter() - t0p
+            per_replica = []
+            reused = prompt = hits = misses = 0.0
+            for p in (p1, p2):
+                row = {
+                    "port": p,
+                    "reused_tokens": replica_metric(
+                        p, "prefix_cache_reused_tokens_total"),
+                    "prompt_tokens": replica_metric(
+                        p, "tokens_prompt_total"),
+                    "hits": replica_metric(p, "prefix_cache_hits_total"),
+                    "misses": replica_metric(
+                        p, "prefix_cache_misses_total"),
+                }
+                per_replica.append(row)
+                reused += row["reused_tokens"]
+                prompt += row["prompt_tokens"]
+                hits += row["hits"]
+                misses += row["misses"]
+            warm.sort()
+            turn1.sort()
+            return {
+                "policy": policy,
+                # THE headline: fraction of submitted prompt tokens
+                # served from cached KV pages, fleet-wide
+                "hit_ratio_tokens": (round(reused / prompt, 4)
+                                     if prompt else 0.0),
+                "hit_ratio_requests": (round(hits / (hits + misses), 4)
+                                       if hits + misses else 0.0),
+                "warm_ttft_ms_p50": (round(pq(warm, 0.5), 1)
+                                     if warm else None),
+                "turn1_ttft_ms_p50": (round(pq(turn1, 0.5), 1)
+                                      if turn1 else None),
+                "warm_samples": len(warm),
+                "errors": errors[:8],
+                "per_replica": per_replica,
+                "router": dict(router.counters),
+                "wall_s": round(wall, 1),
+            }
+
+        aff = fleet_phase("affinity", port)
+        ctl = fleet_phase("roundrobin", port + 10)
+        ratio = (aff["hit_ratio_tokens"] / ctl["hit_ratio_tokens"]
+                 if ctl["hit_ratio_tokens"] else None)
+        result = {
+            "metric": (f"fleet_prefix_hit_ratio[/response,{preset},"
+                       f"{wfmt},affinity]"),
+            "value": aff["hit_ratio_tokens"],
+            "unit": "ratio",
+            "vs_roundrobin_control": (round(ratio, 2)
+                                      if ratio is not None else None),
+            "affinity": aff,
+            "control": ctl,
+            "conversations": convs,
+            "turns": turns,
+            "kv_page_tokens": page_tokens,
+            "max_tokens": max_tokens,
+            "decode_chunk": settings.decode_chunk,
+            "device": str(dev),
+        }
+        emit_result(result)
+        os._exit(0)  # daemon server threads: skip graceful teardown
+
     if batch > 1:
         # continuous batching on one chip: B slot-scheduled lanes amortize
         # every weight read over up to B decode tokens — the aggregate-

@@ -156,6 +156,35 @@ METRICS: dict[str, Metric] = _register(
            buckets=LATENCY_BUCKETS),
     Metric("disagg_peer_connected", GAUGE,
            "decode replica: 1 while the prefill peer connection is up"),
+    # -- fleet tier: the prefix-affinity router (serving/fleet/) -----------
+    Metric("fleet_requests_total", COUNTER,
+           "router: requests proxied, by serving replica and affinity-"
+           "key source (header | conversation | prefix | opaque)",
+           labels=("peer", "source")),
+    Metric("fleet_spills_total", COUNTER,
+           "router: requests NOT served by their rendezvous owner, by "
+           "reason (ejected = retried onto the next peer, spilled = "
+           "served off-owner, mid_stream_abort = peer died after bytes "
+           "reached the client, no_replica = whole fleet down -> 503); "
+           "sustained nonzero = conversations are running cold",
+           labels=("reason",)),
+    Metric("fleet_peer_ejections_total", COUNTER,
+           "router: replica ejections (probe failure or proxied-request "
+           "failure), by peer — the /health peers block names the reason",
+           labels=("peer",)),
+    Metric("fleet_peers_healthy", GAUGE,
+           "router: replicas currently accepting traffic"),
+    Metric("fleet_proxy_seconds", HISTOGRAM,
+           "router: one proxied request's wall (client head in -> "
+           "backend response relayed)",
+           buckets=LATENCY_BUCKETS),
+    # -- live manifest reload (serving/registry.py reload_manifest) --------
+    Metric("model_reloads_total", COUNTER,
+           "live-reload actions on the model registry (add = model "
+           "loaded+warmed in place, remove = namespace drained + weights "
+           "released, refused = budget/fit/grammar refusal with the "
+           "running set untouched)",
+           labels=("action",)),
     # -- prefill pipeline (overlapped chunked prefill + admission control) --
     Metric("prefill_slice_seconds", HISTOGRAM,
            "host wall of one prefill-slice dispatch (prep + enqueue; "

@@ -294,6 +294,7 @@ class KVPool:
             "hits": 0, "misses": 0, "reused_tokens": 0, "commits": 0,
             "stored_pages": 0, "evictions": 0, "spills": 0, "restores": 0,
             "store_skips": 0, "exported_pages": 0, "imported_pages": 0,
+            "drained_pages": 0,
         }
         # lfkt-mem: attribute the arena into the process memory ledger —
         # indexed pages per namespace (model), the free list, and the
@@ -548,6 +549,58 @@ class KVPool:
         the continuous scheduler's freed-lane path."""
         return self._commit_impl(list(ids), bcache=bcache, lane=lane,
                                  span=span, namespace=namespace)
+
+    def drain_namespace(self, namespace: str) -> int:
+        """Retire one namespace's index (live model removal — serving/
+        registry.py ``reload_manifest``): DROP every droppable node of
+        ``namespace`` — device pages go straight to the free list, spilled
+        stacks are released — and return the device pages the namespace
+        still holds (pages pinned by in-flight leases, or nodes an
+        in-progress walk marked busy; the caller polls until 0 under its
+        drain budget).  Dropping, not spilling: the model is leaving, so
+        its KV is garbage — and only THIS namespace is touched, so
+        retiring a model can never evict a surviving tenant's warm pages
+        (no cross-namespace eviction storm — pinned by test).  When the
+        namespace empties, its root (and ledger row) is removed; a
+        namespace never committed to is a no-op."""
+        with self._lock:
+            root = self._roots.get(namespace)
+            if root is None:
+                self._ns_pages.pop(namespace, None)
+                return 0
+            order: list[_Node] = []
+            stack = list(root.children.values())
+            while stack:
+                n = stack.pop()
+                order.append(n)
+                stack.extend(n.children.values())
+            drained = 0
+            # children-first (reversed DFS order): dropping a subtree's
+            # leaves turns its interior nodes into droppable leaves within
+            # the same pass
+            for node in reversed(order):
+                if node.children or id(node) in self._busy:
+                    continue
+                if node.pages is not None:
+                    if any(p in self._page_refs for p in node.pages):
+                        continue        # pinned by an in-flight lease
+                    n = len(node.pages)
+                    self._free.extend(node.pages)
+                    node.pages = None
+                    self._ns_pages[namespace] = max(
+                        0, self._ns_pages.get(namespace, 0) - n)
+                    drained += n
+                else:
+                    self._spill_used -= len(node.edge)
+                    node.host = None
+                self._unlink(node)
+            if drained:
+                self.counters["drained_pages"] += drained
+            if not root.children:
+                self._roots.pop(namespace, None)
+                self._ns_pages.pop(namespace, None)
+                return 0
+            return self._ns_pages.get(namespace, 0)
 
     def reset(self) -> None:
         """Drop the index (EVERY namespace) and free every page (watchdog

@@ -30,7 +30,6 @@ def main():
             "and decode into cooperating processes streaming KV pages "
             "(docs/RUNBOOK.md 'Operating a split prefill/decode "
             "fleet'); scale across chips with k8s replicas.")
-    force_cpu_if_requested()   # site-hook defense (one copy: utils/config)
     host = knob("LFKT_HOST")
     port = knob("LFKT_PORT")
     # structured serving logs: one JSON object per line, every record
@@ -45,6 +44,29 @@ def main():
         for h in list(root.handlers):   # replace basicConfig's text handler
             root.removeHandler(h)
         setup_json_logging()
+    # fleet router (serving/fleet/; docs/RUNBOOK.md "Running a replica
+    # fleet"): the THIRD process role after serving and disagg tiers —
+    # a prefix-affinity proxy over the replica fleet.  Checked BEFORE any
+    # model machinery (even the CPU pin): a router pod has no engine, no
+    # jax, no uvicorn — it is a placement process.
+    fleet_role = knob("LFKT_FLEET_ROLE", default="off")
+    if fleet_role == "router":
+        import logging
+
+        from ..serving.fleet import run_router
+
+        logging.basicConfig(level=logging.INFO)
+        run_router(host, port)
+        return
+    if fleet_role != "off":
+        from ..serving.fleet import FLEET_ROLES
+
+        raise SystemExit(
+            f"LFKT_FLEET_ROLE must be one of {'|'.join(FLEET_ROLES)}, "
+            f"got {fleet_role!r}: replicas stay role=off; only the "
+            "router process changes type (docs/RUNBOOK.md 'Running a "
+            "replica fleet')")
+    force_cpu_if_requested()   # site-hook defense (one copy: utils/config)
     try:
         import uvicorn
     except ImportError:

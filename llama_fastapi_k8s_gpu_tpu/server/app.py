@@ -25,6 +25,7 @@ import asyncio
 import inspect
 import logging
 import math
+import signal
 import time
 from datetime import datetime
 
@@ -178,6 +179,9 @@ def create_app(engine=None, settings: Settings | None = None,
     #: disaggregated prefill/decode roles (serving/disagg/): armed at
     #: startup from LFKT_DISAGG_ROLE; None = the single-process path
     app.state.disagg = None
+    #: live manifest reload (serving/registry.py reload_manifest): one
+    #: reload at a time — POST /admin/models/reload and SIGHUP share it
+    app.state.reload_busy = asyncio.Lock()
     app.state.engine_kw = {}   # which resilience kwargs the engine accepts
     # strong refs to fire-and-forget tasks: the loop holds only weak refs,
     # so an unreferenced task can be garbage-collected mid-flight (losing
@@ -824,6 +828,21 @@ def create_app(engine=None, settings: Settings | None = None,
                 backoff_max=settings.watchdog_backoff_max,
             ).start()
         app.state.consumer_task = asyncio.create_task(consumer())
+        # SIGHUP = re-read LFKT_MODELS and converge the running registry
+        # to it (the POST /admin/models/reload twin for operators who
+        # patch the pod env / mounted config rather than POSTing —
+        # docs/MULTIMODEL.md "Live manifest reload").  Registered only
+        # where signals are available (main thread); no-op refusal with
+        # attribution on single-model pods.
+        if hasattr(signal, "SIGHUP"):
+            try:
+                asyncio.get_running_loop().add_signal_handler(
+                    signal.SIGHUP,
+                    lambda: _spawn(_reload_from_env("SIGHUP")))
+            except (NotImplementedError, RuntimeError, ValueError):
+                # non-main thread (tests/embedding) or unsupported
+                # platform: the admin route remains the reload surface
+                pass
 
     @app.on_event("shutdown")
     async def shutdown_event():
@@ -989,11 +1008,16 @@ def create_app(engine=None, settings: Settings | None = None,
     @app.get("/v1/models")
     async def v1_models():
         """The served model manifest, OpenAI list-shaped: one row per
-        registry alias (single-model pods list their one model)."""
+        ROUTABLE registry alias (single-model pods list their one model).
+        Mid-reload rows — ``loading`` (weights still coming up) and
+        ``draining`` (leaving; new requests already 400) — are /health's
+        business: advertising them here would invite traffic the router
+        cannot place."""
         eng = app.state.engine
         models_fn = getattr(eng, "models", None)
         if callable(models_fn):
-            names = [r["name"] for r in models_fn()]
+            names = [r["name"] for r in models_fn()
+                     if r.get("state") in (None, "ready", "loaded")]
         else:
             names = [getattr(eng, "model_name", None)
                      or app.state.settings.model_name]
@@ -1003,6 +1027,82 @@ def create_app(engine=None, settings: Settings | None = None,
                       "created": app.state.created, "owned_by": "lfkt"}
                      for n in names],
         }
+
+    # -- live manifest reload (serving/registry.py; docs/MULTIMODEL.md) ----
+    async def _do_reload(manifest: str, default: str) -> dict:
+        """Run one registry reload on a worker thread (loads/warmups take
+        seconds-minutes; traffic on the live models keeps flowing)."""
+        eng = app.state.engine
+        reload_fn = getattr(eng, "reload_manifest", None)
+        if not callable(reload_fn):
+            raise HTTPException(
+                status_code=400,
+                detail="live reload requires manifest serving: this pod "
+                       "runs a single engine (set LFKT_MODELS — "
+                       "docs/MULTIMODEL.md)")
+        if not manifest:
+            raise HTTPException(
+                status_code=400,
+                detail="no manifest: pass {\"models\": \"name=path,...\"} "
+                       "or set LFKT_MODELS on the pod")
+        return await asyncio.to_thread(
+            reload_fn, manifest, default,
+            drain_seconds=settings.reload_drain_seconds)
+
+    async def _reload_from_env(origin: str) -> None:
+        """The SIGHUP path: env is re-read at signal time, so editing the
+        pod's LFKT_MODELS (mounted-config pattern) then HUPing converges
+        the registry without a restart."""
+        from ..utils.config import get_settings as _fresh_settings
+
+        live = _fresh_settings()
+        async with app.state.reload_busy:
+            try:
+                doc = await _do_reload(live.models, live.default_model)
+                logger.info("%s reload: added=%s removed=%s default=%s",
+                            origin, doc["added"],
+                            [r["name"] for r in doc["removed"]],
+                            doc["default_model"])
+            except HTTPException as e:
+                logger.error("%s reload refused: %s", origin, e.detail)
+            except Exception as e:  # noqa: BLE001 — a failed background
+                # reload must be loud but never kill the serving loop
+                logger.error("%s reload failed: %s", origin, e)
+
+    @app.post("/admin/models/reload")
+    async def admin_models_reload(request: Request):
+        """Diff a new ``LFKT_MODELS`` manifest against the running
+        registry and converge to it live: additions load under the fit
+        check + weight budget (409 on refusal, running set untouched),
+        removals drain their in-flight requests and radix namespace
+        before the weights release.  Body (all optional): ``models`` (the
+        manifest string; default = the pod's current LFKT_MODELS env,
+        re-read), ``default_model``.  Returns the registry's reload
+        report.  409 while another reload runs."""
+        from ..serving import WeightBudgetError
+        from ..utils.config import get_settings as _fresh_settings
+
+        try:
+            body = await request.json()
+        except ValueError:
+            raise HTTPException(status_code=400, detail="body must be JSON")
+        body = body if isinstance(body, dict) else {}
+        live = _fresh_settings()
+        manifest = body.get("models") or live.models
+        default = body.get("default_model") or live.default_model
+        if app.state.reload_busy.locked():
+            raise HTTPException(
+                status_code=409,
+                detail="a reload is already in progress; retry after it "
+                       "completes (/health models rows show the "
+                       "transition)")
+        async with app.state.reload_busy:
+            try:
+                return await _do_reload(manifest, default)
+            except WeightBudgetError as e:
+                raise HTTPException(status_code=409, detail=str(e))
+            except ValueError as e:
+                raise HTTPException(status_code=400, detail=str(e))
 
     def _v1_params(body: ChatCompletionRequest) -> dict:
         """The request's explicitly-set sampling fields (unset ones fall

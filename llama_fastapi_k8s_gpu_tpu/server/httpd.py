@@ -29,7 +29,7 @@ logger = logging.getLogger(__name__)
 
 _REASONS = {
     200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
-    408: "Request Timeout", 422: "Unprocessable Entity",
+    408: "Request Timeout", 409: "Conflict", 422: "Unprocessable Entity",
     500: "Internal Server Error", 501: "Not Implemented",
     503: "Service Unavailable",
 }
@@ -71,6 +71,7 @@ async def _handle_request(app, reader, writer, peer, request_line,
         headers = []
         content_length = None
         chunked = False
+        close_requested = False
         while True:
             line = await reader.readline()
             if line in (b"\r\n", b"\n", b""):
@@ -79,6 +80,13 @@ async def _handle_request(app, reader, writer, peer, request_line,
             name = name.strip().lower()
             value = value.strip()
             headers.append((name.encode(), value.encode()))
+            if name == "connection" and "close" in value.lower():
+                # honor the client's one-request intent (RFC 9112 §9.6):
+                # proxies (the fleet router) and strict HTTP/1.1 clients
+                # frame "response ends" as "connection closes" — before
+                # this the server kept the socket open and such callers
+                # hung waiting for an EOF that never came
+                close_requested = True
             if name == "content-length":
                 try:
                     cl = int(value)
@@ -106,7 +114,7 @@ async def _handle_request(app, reader, writer, peer, request_line,
         content_length = content_length or 0
         body = (await reader.readexactly(content_length)
                 if content_length else b"")
-        return headers, body
+        return headers, body, close_requested
 
     # slowloris guard: once the request line has arrived, the rest of the
     # head + body must finish arriving within the read deadline — a client
@@ -120,7 +128,7 @@ async def _handle_request(app, reader, writer, peer, request_line,
         return await _reject(writer, 408, "request read timeout")
     if got is False:
         return False                     # _reject already answered
-    headers, body = got
+    headers, body, close_requested = got
 
     path, _, query = target.partition("?")
     scope = {
@@ -163,9 +171,11 @@ async def _handle_request(app, reader, writer, peer, request_line,
         elif not has_length:
             head.append(
                 b"content-length: " + str(len(response["body"])).encode())
-        # honest connection signaling: during drain the handler closes the
-        # socket after this response, so clients must not reuse it
-        head.append(b"connection: close" if state["draining"]
+        # honest connection signaling: during drain — or when the client
+        # itself sent "connection: close" — the handler closes the socket
+        # after this response, so clients must not reuse it
+        head.append(b"connection: close"
+                    if state["draining"] or close_requested
                     else b"connection: keep-alive")
         writer.write(b"\r\n".join(head) + b"\r\n\r\n")
 
@@ -198,7 +208,7 @@ async def _handle_request(app, reader, writer, peer, request_line,
         _write_head(chunked=False)
         writer.write(response["body"])
         await writer.drain()
-    return not state["draining"]
+    return not state["draining"] and not close_requested
 
 
 async def _handle_connection(app, reader: asyncio.StreamReader,

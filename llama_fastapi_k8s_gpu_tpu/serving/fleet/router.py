@@ -1,0 +1,458 @@
+"""The fleet router: a thin prefix-affinity HTTP proxy over the replicas.
+
+``LFKT_FLEET_ROLE=router`` runs this instead of a serving app — no
+model, no jax, one asyncio loop.  Every request is keyed by
+:func:`..fleet.affinity.affinity_key` and proxied to the replica that
+rendezvous-hashing says owns the key (``serving/fleet/affinity.py``),
+so a conversation's turns keep landing on the replica whose radix tree
+already holds their KV pages.  ``policy="roundrobin"`` is the A/B
+control arm (``bench_server.py`` fleet arm; ``LFKT_FLEET_POLICY``).
+
+Proxying is RAW: the backend's status line, headers (minus hop-by-hop
+connection signaling) and body bytes are relayed verbatim as they
+arrive — so streaming SSE passes through chunk by chunk, and a greedy
+completion through the router is byte-identical to direct-to-replica
+(pinned by tests/test_fleet.py + the ci_gate ``fleet-route-parity``
+check).  One request per client connection (``connection: close``):
+the router's job is placement, not connection pooling.
+
+Failure contract — never a hang, never a 502 for one dead pod:
+
+- connect/head failure BEFORE any response byte reached the client:
+  eject the peer with attribution (peers.py) and retry the request on
+  the rendezvous-NEXT healthy peer (``fleet_spills_total{reason}``);
+  only when EVERY replica refused does the client see a 503.
+- failure MID-RESPONSE (bytes already forwarded): the peer is ejected,
+  the client connection closes (the router cannot replay a partially
+  delivered generation), and the client's retry — a fresh request —
+  spills to the survivor.
+- every backend read rides a deadline: connect/head on
+  ``LFKT_FLEET_PROXY_TIMEOUT_SECONDS``, body progress on the stream
+  wall budget (``LFKT_STREAM_DEADLINE_SECONDS``).
+
+The router answers ``/health`` (role, policy, per-peer state with
+attributed ejection reasons), ``/health/ready`` (200 iff >= 1 healthy
+replica — k8s stops routing to a router whose whole fleet is down),
+``/health/live`` and ``/metrics`` (the ``fleet_*`` families) itself;
+everything else is proxied.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import signal
+import time
+
+from .affinity import affinity_key, rendezvous_rank
+
+logger = logging.getLogger(__name__)
+
+#: response head elements the proxy rewrites rather than relays:
+#: connection signaling is hop-by-hop (RFC 9110 §7.6.1)
+_HOP_HEADERS = (b"connection", b"keep-alive", b"proxy-connection")
+
+_READ_CHUNK = 65536
+
+
+class _BackendError(Exception):
+    """One proxy attempt failed against one peer (reason attributed)."""
+
+    def __init__(self, reason: str, mid_stream: bool = False):
+        super().__init__(reason)
+        self.reason = reason
+        self.mid_stream = mid_stream
+
+
+class FleetRouter:
+    """See module docstring.  ``peers`` is a started
+    :class:`..fleet.peers.PeerTable`; ``metrics`` a
+    :class:`...utils.metrics.Metrics` registry (the catalog enforces the
+    ``fleet_*`` families)."""
+
+    def __init__(self, peers, policy: str = "affinity", metrics=None,
+                 proxy_timeout: float = 5.0,
+                 stream_timeout: float = 300.0):
+        if policy not in ("affinity", "roundrobin"):
+            raise ValueError(
+                f"LFKT_FLEET_POLICY must be affinity|roundrobin, "
+                f"got {policy!r}")
+        self.peers = peers
+        self.policy = policy
+        self.metrics = metrics
+        self.proxy_timeout = proxy_timeout
+        self.stream_timeout = stream_timeout
+        self._rr = 0
+        self.started = int(time.time())
+        #: monotonic counters for /health (the /metrics twins are inc'd
+        #: at event time); plain ints mutated on the one event loop
+        self.counters = {
+            "proxied": 0, "spills": 0, "mid_stream_aborts": 0,
+            "no_replica_503s": 0,
+        }
+
+    # -- telemetry ---------------------------------------------------------
+    def _emit(self, kind: str, name: str, value: float = 1.0, **labels):
+        m = self.metrics
+        if m is None:
+            return
+        try:
+            getattr(m, kind)(name, value, **labels)
+        except Exception:  # noqa: BLE001 — telemetry must never fail routing
+            pass
+
+    # -- routing -----------------------------------------------------------
+    def rank(self, key: str) -> list[str]:
+        """Full preference order for ``key`` over ALL known replicas
+        (healthy or not — the caller skips unhealthy ones and counts the
+        skip as a spill, so ownership is stable across flaps)."""
+        addrs = self.peers.addrs()
+        if self.policy == "roundrobin":
+            if not addrs:
+                return []
+            self._rr = (self._rr + 1) % len(addrs)
+            return addrs[self._rr:] + addrs[:self._rr]
+        return rendezvous_rank(key, addrs)
+
+    # -- local endpoints ---------------------------------------------------
+    def _health_doc(self) -> dict:
+        return {
+            "role": "router",
+            "policy": self.policy,
+            "started": self.started,
+            "counters": dict(self.counters),
+            **self.peers.snapshot(),
+        }
+
+    def _local_response(self, path: str):
+        """(status, content_type, body) for router-owned routes, or None
+        to proxy."""
+        if path == "/health":
+            return 200, "application/json", json.dumps(self._health_doc())
+        if path == "/health/ready":
+            n = len(self.peers.healthy())
+            return (200 if n else 503), "application/json", json.dumps(
+                {"ready": bool(n), "role": "router", "healthy_replicas": n})
+        if path == "/health/live":
+            return 200, "application/json", json.dumps(
+                {"alive": True, "role": "router"})
+        if path == "/metrics" and self.metrics is not None:
+            self._emit("set_gauge", "fleet_peers_healthy",
+                       len(self.peers.healthy()))
+            return 200, "text/plain; version=0.0.4", self.metrics.render()
+        return None
+
+    # -- one proxy attempt -------------------------------------------------
+    async def _proxy_attempt(self, addr: str, head: bytes, body: bytes,
+                             writer: asyncio.StreamWriter,
+                             sent: list) -> int:
+        """Forward one request to ``addr``, relaying the response to
+        ``writer`` as it arrives.  ``sent`` flips truthy once the first
+        response byte reaches the client (the no-retry point).  Returns
+        the backend status; raises :class:`_BackendError` otherwise."""
+        host, _, port = addr.rpartition(":")
+        try:
+            r2, w2 = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)),
+                self.proxy_timeout)
+        except (OSError, asyncio.TimeoutError) as e:
+            raise _BackendError(f"connect: {type(e).__name__}: {e}")
+        try:
+            w2.write(head + body)
+            try:
+                await asyncio.wait_for(w2.drain(), self.proxy_timeout)
+                # the status line waits on the STREAM budget, not the
+                # connect timeout: a buffered non-streaming /response
+                # sends its head only after the full generation, and a
+                # 5s head deadline would eject a healthy replica for
+                # serving a slow prompt (then replay the generation
+                # fleet-wide).  Dead-socket detection stays fast via the
+                # prober; a connected-but-silent backend is bounded here.
+                status_line = await asyncio.wait_for(
+                    r2.readline(), self.stream_timeout)
+                resp_head = [status_line]
+                while True:
+                    line = await asyncio.wait_for(r2.readline(),
+                                                  self.proxy_timeout)
+                    resp_head.append(line)
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+            except (OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError) as e:
+                raise _BackendError(f"head: {type(e).__name__}: {e}")
+            try:
+                status = int(status_line.split()[1])
+            except (IndexError, ValueError):
+                raise _BackendError(
+                    f"head: malformed status line {status_line!r}")
+            content_length = None
+            chunked = False
+            out = [status_line]
+            for line in resp_head[1:-1]:
+                name, _, value = line.partition(b":")
+                lname = name.strip().lower()
+                if lname in _HOP_HEADERS:
+                    continue
+                if lname == b"content-length":
+                    try:
+                        content_length = int(value.strip())
+                    except ValueError:
+                        pass
+                elif lname == b"transfer-encoding" \
+                        and b"chunked" in value.lower():
+                    chunked = True
+                out.append(line)
+            out.append(b"connection: close\r\n\r\n")
+            writer.write(b"".join(out))
+            sent.append(True)
+            # relay the body VERBATIM (byte-identity is the contract),
+            # tracking the backend's own framing to know where the
+            # response ends — EOF alone is not a terminator for
+            # keep-alive backends
+            deadline = time.time() + self.stream_timeout
+
+            async def _read(coro):
+                gap = deadline - time.time()
+                if gap <= 0:
+                    raise _BackendError("body: stream wall budget "
+                                        "exhausted", mid_stream=True)
+                try:
+                    return await asyncio.wait_for(coro, gap)
+                except (OSError, asyncio.TimeoutError,
+                        asyncio.IncompleteReadError) as e:
+                    raise _BackendError(
+                        f"body: {type(e).__name__}: {e}", mid_stream=True)
+
+            if chunked:
+                # incremental chunked walk: every byte (size lines, data,
+                # the terminal 0-chunk) is relayed untouched; parsing is
+                # only for finding the end, so SSE streams flush to the
+                # client chunk by chunk as they arrive
+                while True:
+                    size_line = await _read(r2.readline())
+                    if not size_line:
+                        raise _BackendError("body: EOF inside chunked "
+                                            "stream", mid_stream=True)
+                    writer.write(size_line)
+                    try:
+                        size = int(size_line.strip().split(b";")[0], 16)
+                    except ValueError:
+                        raise _BackendError(
+                            f"body: bad chunk size {size_line!r}",
+                            mid_stream=True)
+                    data = await _read(r2.readexactly(size + 2))
+                    writer.write(data)
+                    await writer.drain()
+                    if size == 0:
+                        break
+            elif content_length is not None:
+                remaining = content_length
+                while remaining > 0:
+                    chunk = await _read(
+                        r2.read(min(_READ_CHUNK, remaining)))
+                    if not chunk:
+                        raise _BackendError("body: EOF mid-response",
+                                            mid_stream=True)
+                    remaining -= len(chunk)
+                    writer.write(chunk)
+                    await writer.drain()
+            else:
+                # no framing: the response ends when the backend closes
+                while True:
+                    chunk = await _read(r2.read(_READ_CHUNK))
+                    if not chunk:
+                        break
+                    writer.write(chunk)
+                    await writer.drain()
+            return status
+        finally:
+            try:
+                w2.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- one client request ------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            await self._handle_inner(reader, writer)
+        except (ConnectionResetError, BrokenPipeError,
+                asyncio.IncompleteReadError):
+            pass
+        except Exception as e:  # noqa: BLE001 — one bad request must not
+            # take the router down; the client sees the closed socket
+            logger.error("router request failed: %s", e)
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    @staticmethod
+    async def _read_request(reader):
+        """(method, target, headers dict, raw header lines, body) or None
+        on a malformed/empty request."""
+        request_line = await reader.readline()
+        if not request_line:
+            return None
+        try:
+            method, target, _version = request_line.decode().split()
+        except ValueError:
+            return None
+        raw_headers = []
+        headers: dict[str, str] = {}
+        content_length = 0
+        chunked = False
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            raw_headers.append(line)
+            name, _, value = line.decode("latin-1").partition(":")
+            name = name.strip().lower()
+            value = value.strip()
+            headers[name] = value
+            if name == "content-length":
+                try:
+                    content_length = max(0, int(value))
+                except ValueError:
+                    return None
+            elif name == "transfer-encoding":
+                chunked = True
+        if chunked:
+            # chunked REQUEST bodies are not relayed (the backend httpd
+            # refuses them too); forwarding the header with a rewritten
+            # content-length would send conflicting framing and silently
+            # drop the body — refuse honestly instead
+            return "chunked"
+        body = (await reader.readexactly(content_length)
+                if content_length else b"")
+        return method, target, headers, raw_headers, body
+
+    def _write_simple(self, writer, status: int, ctype: str, body) -> None:
+        if isinstance(body, str):
+            body = body.encode()
+        reason = {200: "OK", 503: "Service Unavailable",
+                  408: "Request Timeout",
+                  501: "Not Implemented"}.get(status, "")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"content-type: {ctype}\r\n"
+            f"content-length: {len(body)}\r\n"
+            "connection: close\r\n\r\n".encode() + body)
+
+    async def _handle_inner(self, reader, writer) -> None:
+        try:
+            got = await asyncio.wait_for(self._read_request(reader),
+                                         self.proxy_timeout)
+        except asyncio.TimeoutError:
+            self._write_simple(writer, 408, "application/json",
+                               json.dumps({"detail": "request read "
+                                                     "timeout"}))
+            return
+        if got is None:
+            return
+        if got == "chunked":
+            self._write_simple(
+                writer, 501, "application/json",
+                json.dumps({"detail": "chunked transfer-coding not "
+                                      "supported"}))
+            await writer.drain()
+            return
+        method, target, headers, raw_headers, body = got
+        path = target.partition("?")[0]
+        local = self._local_response(path)
+        if local is not None:
+            self._write_simple(writer, *local)
+            await writer.drain()
+            return
+
+        key, source = affinity_key(path, headers, body)
+        order = self.rank(key)
+        owner = order[0] if order else None
+        # forward the request with hop-by-hop headers rewritten: the
+        # backend sees connection: close (EOF = end of response) and an
+        # exact content-length; everything else (traceparent, affinity
+        # header, content-type) passes through
+        fwd = [f"{method} {target} HTTP/1.1\r\n".encode()]
+        for line in raw_headers:
+            if line.split(b":", 1)[0].strip().lower() in _HOP_HEADERS \
+                    + (b"content-length", b"host"):
+                continue
+            fwd.append(line)
+        fwd.append(f"host: {owner or 'fleet'}\r\n".encode())
+        if body or method in ("POST", "PUT", "PATCH"):
+            fwd.append(f"content-length: {len(body)}\r\n".encode())
+        fwd.append(b"connection: close\r\n\r\n")
+        head = b"".join(fwd)
+
+        sent: list = []
+        t0 = time.time()
+        for addr in order:
+            if not self.peers.is_healthy(addr):
+                continue
+            try:
+                await self._proxy_attempt(addr, head, body, writer, sent)
+            except _BackendError as e:
+                self.peers.eject(addr, f"proxy {e.reason}")
+                self._emit("set_gauge", "fleet_peers_healthy",
+                           len(self.peers.healthy()))
+                if sent:
+                    # bytes already reached the client: the router cannot
+                    # replay a partially delivered response — close, and
+                    # let the client's retry spill to a survivor
+                    self.counters["mid_stream_aborts"] += 1
+                    self._emit("inc", "fleet_spills_total",
+                               reason="mid_stream_abort")
+                    logger.warning("fleet: %s died mid-response for key "
+                                   "%s; client connection closed", addr,
+                                   key[:16])
+                    return
+                self.counters["spills"] += 1
+                self._emit("inc", "fleet_spills_total", reason="ejected")
+                continue
+            # success
+            self.counters["proxied"] += 1
+            self._emit("inc", "fleet_requests_total", peer=addr,
+                       source=source)
+            self._emit("observe", "fleet_proxy_seconds", time.time() - t0)
+            if self.policy == "affinity" and addr != owner:
+                # served, but off the rendezvous owner: the owner is
+                # ejected and this request warmed its spill target
+                self.counters["spills"] += 1
+                self._emit("inc", "fleet_spills_total", reason="spilled")
+            await writer.drain()
+            return
+        # every replica unhealthy or refused pre-send
+        self.counters["no_replica_503s"] += 1
+        self._emit("inc", "fleet_spills_total", reason="no_replica")
+        self._write_simple(
+            writer, 503, "application/json",
+            json.dumps({"detail": "no healthy replica (fleet router); "
+                                  "see the router's /health for per-peer "
+                                  "attribution"}))
+        await writer.drain()
+
+    # -- serving -----------------------------------------------------------
+    async def serve(self, host: str = "0.0.0.0", port: int = 8000,
+                    ready_event: asyncio.Event | None = None,
+                    stop_event: asyncio.Event | None = None) -> None:
+        server = await asyncio.start_server(self._handle, host, port)
+        logger.info("fleet router listening on %s:%d (%d replicas, "
+                    "policy=%s)", host, port, len(self.peers.addrs()),
+                    self.policy)
+        if ready_event is not None:
+            ready_event.set()
+        stop = stop_event if stop_event is not None else asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except (NotImplementedError, RuntimeError, ValueError):
+                # non-main thread (tests/bench) or unsupported platform
+                pass
+        async with server:
+            await stop.wait()
+        self.peers.stop()

@@ -36,9 +36,11 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
+import time
 
 from ..obs import memledger as _memledger
-from .manifest import ModelSpec
+from .manifest import ModelSpec, parse_manifest, pick_default
 
 logger = logging.getLogger(__name__)
 
@@ -93,6 +95,17 @@ class ModelRegistry:
     provides them, so the server's capability probes keep working.
     """
 
+    # -- lock discipline (lfkt-lint LOCK001-004): one mutex guards the
+    # routing dict, the descriptor rows and the in-flight counters; the
+    # separate _reload_lock serializes whole reload operations (loads
+    # run OUTSIDE _lock — a multi-GB load must not stall resolve())
+    _GUARDED_BY = {
+        "_engines": "_lock",
+        "_model_info": "_lock",
+        "_inflight": "_lock",
+        "_specs": "_lock",
+    }
+
     def __init__(self, engines: dict[str, object], default_model: str,
                  model_info: list[dict] | None = None):
         if not engines:
@@ -101,6 +114,20 @@ class ModelRegistry:
             raise ValueError(
                 f"default model {default_model!r} is not among "
                 f"{', '.join(engines)}")
+        self._lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        #: in-flight requests per model alias — reload's removal path
+        #: waits for a model's count to reach zero before draining its
+        #: namespace and releasing its weights
+        self._inflight: dict[str, int] = {}
+        #: the manifest specs behind each live engine (from_specs fills
+        #: this; direct construction leaves it empty, which disables
+        #: override-change detection but still allows remove-only reloads)
+        self._specs: dict[str, ModelSpec] = {}
+        #: reload plumbing (from_specs): the engine builder + its inputs
+        self._build = None
+        self._model_dir = "models"
+        self._weight_budget_bytes = 0
         self._engines = dict(engines)
         for name, eng in self._engines.items():
             # the registry alias IS the serving identity: responses,
@@ -135,7 +162,14 @@ class ModelRegistry:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _describe(name: str, engine, path: str | None) -> dict:
+    def _describe(name: str, engine, path: str | None,
+                  state: str = "ready") -> dict:
+        # ``state`` is the live-reload observability surface (ISSUE 14):
+        # loading (fit-checked, weights still coming up) -> ready
+        # (routable) -> draining (unrouted, in-flight finishing + radix
+        # namespace retiring).  /health shows every row; /v1/models lists
+        # only routable ones — a half-reloaded pod is observable, never
+        # lying.
         cfg = getattr(engine, "cfg", None)
         return {
             "name": name,
@@ -144,7 +178,7 @@ class ModelRegistry:
             "weight_bytes": int(getattr(engine, "weight_bytes", 0) or 0),
             "n_ctx": getattr(cfg, "n_ctx", None),
             "kv_dtype": getattr(cfg, "kv_dtype", None),
-            "state": "loaded",
+            "state": state,
         }
 
     @classmethod
@@ -204,7 +238,15 @@ class ModelRegistry:
             len(engines), used / 1e6,
             f" of {weight_budget_bytes / 1e6:.0f}MB budget"
             if weight_budget_bytes else "", default_model)
-        return cls(engines, default_model, model_info=info)
+        reg = cls(engines, default_model, model_info=info)
+        # live-reload plumbing (reload_manifest): the SAME builder +
+        # budget the startup load used, so a reloaded model is shaped
+        # exactly like a boot-loaded one
+        reg._build = build
+        reg._model_dir = model_dir
+        reg._weight_budget_bytes = weight_budget_bytes
+        reg._specs = {s.name: s for s in specs}
+        return reg
 
     # -- routing --------------------------------------------------------
     def model_names(self) -> list[str]:
@@ -224,27 +266,92 @@ class ModelRegistry:
     def models(self) -> list[dict]:
         """Manifest descriptor rows — ``GET /v1/models`` and the /health
         ``models`` block (name, quant, weight bytes, load state)."""
-        return [dict(r) for r in self._model_info]
+        with self._lock:
+            return [dict(r) for r in self._model_info]
+
+    # -- in-flight accounting (the reload drain's wait condition) --------
+    def _resolve_tracked(self, model: str | None):
+        """(name, engine) with the model's in-flight count raised; every
+        facade entry pairs this with exactly one :meth:`_track_exit`.
+        Lookup and increment share ONE lock acquisition: a reload
+        removing the model either happens-before (the request 400s) or
+        happens-after (the drain sees the raised count and waits) —
+        never in between, where it would shut the engine down under a
+        just-admitted request."""
+        name = model or self.default_model
+        with self._lock:
+            eng = self._engines.get(name)
+            if eng is not None:
+                self._inflight[name] = self._inflight.get(name, 0) + 1
+            known = list(self._engines)
+        if eng is None:
+            raise UnknownModelError(name, known)
+        return name, eng
+
+    def _track_exit(self, name: str) -> None:
+        with self._lock:
+            left = self._inflight.get(name, 0) - 1
+            if left > 0:
+                self._inflight[name] = left
+            else:
+                self._inflight.pop(name, None)
+
+    def inflight(self, name: str) -> int:
+        with self._lock:
+            return self._inflight.get(name, 0)
+
+    def _tracked_iter(self, name: str, it):
+        """Stream wrapper: the request stays in-flight until the engine
+        iterator finishes OR the caller closes it (disconnect path)."""
+        try:
+            yield from it
+        finally:
+            self._track_exit(name)
 
     # -- engine-shaped facade -------------------------------------------
     def create_chat_completion(self, messages, stream: bool = False, *,
                                model: str | None = None, **kw):
-        return self.resolve(model).create_chat_completion(
-            messages, stream=stream, **kw)
+        name, eng = self._resolve_tracked(model)
+        if stream:
+            try:
+                it = eng.create_chat_completion(messages, stream=True,
+                                                **kw)
+            except BaseException:
+                self._track_exit(name)
+                raise
+            return self._tracked_iter(name, it)
+        try:
+            return eng.create_chat_completion(messages, stream=False, **kw)
+        finally:
+            self._track_exit(name)
 
     def _submit(self, messages, *, model: str | None = None, **kw):
-        eng = self.resolve(model)
-        fut = eng.submit(messages, **kw)
+        name, eng = self._resolve_tracked(model)
+        try:
+            fut = eng.submit(messages, **kw)
+        except BaseException:
+            self._track_exit(name)
+            raise
         fut._lfkt_engine = eng           # abandon() routes through this
+        fut.add_done_callback(lambda _f: self._track_exit(name))
         return fut
 
     def _submit_stream(self, messages, *, model: str | None = None, **kw):
-        return self.resolve(model).submit_stream(messages, **kw)
+        name, eng = self._resolve_tracked(model)
+        try:
+            it = eng.submit_stream(messages, **kw)
+        except BaseException:
+            self._track_exit(name)
+            raise
+        return self._tracked_iter(name, it)
 
     def _create_chat_completions(self, batch_messages, *,
                                  model: str | None = None, **kw):
-        return self.resolve(model).create_chat_completions(
-            batch_messages, **kw)
+        name, eng = self._resolve_tracked(model)
+        try:
+            return eng.create_chat_completions(batch_messages, **kw)
+        finally:
+            self._track_exit(name)
 
     def abandon(self, fut) -> None:
         eng = getattr(fut, "_lfkt_engine", None)
@@ -260,6 +367,232 @@ class ModelRegistry:
         for eng in self._engines.values():
             if hasattr(eng, "shutdown"):
                 eng.shutdown()
+
+    # -- live manifest reload (ISSUE 14; docs/MULTIMODEL.md) -------------
+    #: facade capabilities every engine must share; an added engine
+    #: missing one the registry installed at construction would silently
+    #: break the server's capability probes mid-flight — refuse instead
+    _CAPABILITIES = ("submit", "submit_stream", "create_chat_completions",
+                     "scheduler_stats")
+
+    def _emit_reload(self, action: str) -> None:
+        m = self._metrics_sink
+        if m is None:
+            return
+        try:
+            m.inc("model_reloads_total", action=action)
+        except Exception:  # noqa: BLE001 — telemetry must never fail reload
+            pass
+
+    def _set_state(self, name: str, state: str) -> None:
+        with self._lock:
+            for r in self._model_info:
+                if r["name"] == name:
+                    r["state"] = state
+
+    def reload_manifest(self, manifest: str, default_model: str = "", *,
+                        drain_seconds: float = 30.0) -> dict:
+        """Diff a new ``LFKT_MODELS`` manifest against the running set and
+        converge to it WITHOUT a pod restart (``POST /admin/models/reload``
+        and SIGHUP — server/app.py):
+
+        - **added** models load under the memory ledger's pre-load fit
+          check and the HBM weight budget — a refusal
+          (:class:`WeightBudgetError`) unwinds everything this reload
+          loaded and leaves the running set untouched;
+        - **removed** models first leave the routing table (new requests
+          400 with the live model list), then wait out their in-flight
+          requests (bounded by ``drain_seconds``), then retire their
+          radix namespace through the pool's drain path
+          (``KVPool.drain_namespace`` — pages freed, no cross-namespace
+          eviction) before the engine (and its weights) is released;
+        - **kept** models are untouched — changing a kept model's
+          overrides/path is refused with attribution (remove + re-add
+          under the new spec, or restart);
+        - the default alias re-resolves against the NEW manifest
+          (``LFKT_DEFAULT_MODEL`` semantics, pick_default).
+
+        Model rows surface the transition (``loading``/``ready``/
+        ``draining``) in /health throughout; /v1/models lists the
+        routable set.  Returns the reload report."""
+        specs = parse_manifest(manifest)
+        default = pick_default(specs, default_model)
+        with self._reload_lock:
+            return self._reload(specs, default, drain_seconds)
+
+    def _reload(self, specs: list[ModelSpec], default: str,
+                drain_seconds: float) -> dict:
+        t0 = time.time()
+        new_names = {s.name for s in specs}
+        added = [s for s in specs if s.name not in self._engines]
+        removed = [n for n in self._engines if n not in new_names]
+        changed = [s.name for s in specs
+                   if s.name in self._specs and self._specs[s.name] != s]
+        if changed:
+            raise ValueError(
+                f"reload cannot change a live model's spec in place: "
+                f"{', '.join(sorted(changed))} (remove the alias in one "
+                "reload and re-add it under the new path/overrides in the "
+                "next, or restart the pod — docs/MULTIMODEL.md)")
+        if added and self._build is None:
+            raise ValueError(
+                "this registry was not built from a manifest "
+                "(ModelRegistry.from_specs): it can retire models but "
+                "cannot load new ones")
+
+        # -- phase 1: load additions (budget-refusable, running set
+        # untouched until every addition is in hand) ----------------------
+        loaded: list[tuple[ModelSpec, object, dict]] = []
+        try:
+            for spec in added:
+                path = spec.resolved_path(self._model_dir)
+                try:
+                    est = os.path.getsize(path)
+                except OSError:
+                    est = 0         # missing file: let build() name it
+                refusal = _memledger.MEMLEDGER.fit_check(est,
+                                                         label=spec.name)
+                if refusal is not None:
+                    raise WeightBudgetError(refusal)
+                # the loading row is visible in /health BEFORE the
+                # (potentially minutes-long) load — observable, not lying
+                placeholder = {"name": spec.name, "path": path,
+                               "quant": None, "weight_bytes": 0,
+                               "n_ctx": None, "kv_dtype": None,
+                               "state": "loading"}
+                with self._lock:
+                    self._model_info.append(placeholder)
+                eng = self._build(spec, path, self._shared_pool())
+                eng.model_name = spec.name
+                missing = [c for c in self._CAPABILITIES
+                           if hasattr(self, c) and not hasattr(eng, c)]
+                if missing:
+                    raise ValueError(
+                        f"added model {spec.name!r} lacks the fleet's "
+                        f"shared capabilities ({', '.join(missing)}): "
+                        "every co-resident engine must share one serving "
+                        "shape (docs/MULTIMODEL.md)")
+                row = self._describe(spec.name, eng, path=path,
+                                     state="loading")
+                budget = self._weight_budget_bytes
+                used = self._live_weight_bytes() \
+                    + sum(r["weight_bytes"] for _s, _e, r in loaded) \
+                    + row["weight_bytes"]
+                if budget and used > budget:
+                    table = ", ".join(
+                        f"{r['name']}={r['weight_bytes'] / 1e6:.0f}MB"
+                        for r in self.models() + [row]
+                        if r["weight_bytes"])
+                    raise WeightBudgetError(
+                        f"HBM weight budget exhausted reloading "
+                        f"{spec.name!r}: {used / 1e6:.0f}MB of weights vs "
+                        f"LFKT_HBM_WEIGHT_BUDGET_MB={budget / 1e6:.0f}MB "
+                        f"({table}); the running set is untouched "
+                        "(docs/MULTIMODEL.md)")
+                # warm INSIDE the refusable phase: a failed compile
+                # unwinds like a failed load (running set untouched),
+                # instead of leaving earlier additions half-installed.
+                # Appended BEFORE warming so the unwind releases this
+                # engine too when its own warmup raises.
+                loaded.append((spec, eng, row))
+                logger.info("reload: warming up model %r", spec.name)
+                eng.warmup()
+        except Exception:
+            # unwind: release everything THIS reload loaded and drop the
+            # loading rows — the running set stays exactly as it was
+            for _spec, eng, _row in loaded:
+                if hasattr(eng, "shutdown"):
+                    eng.shutdown()
+            with self._lock:
+                self._model_info = [
+                    r for r in self._model_info
+                    if not (r["state"] == "loading"
+                            and r["name"] in {s.name for s in added})]
+            self._emit_reload("refused")
+            raise
+
+        # install: every addition loaded AND warmed (all of phase 1 ran
+        # off the routing lock — live traffic never stalled), so turning
+        # routable is pure bookkeeping with no failure modes left
+        for spec, eng, row in loaded:
+            if self._metrics_sink is not None \
+                    and hasattr(eng, "metrics_sink"):
+                eng.metrics_sink = self._metrics_sink
+            row["state"] = "ready"
+            with self._lock:
+                self._engines[spec.name] = eng
+                self._specs[spec.name] = spec
+                self._model_info = [
+                    r for r in self._model_info
+                    if not (r["name"] == spec.name
+                            and r["state"] == "loading")] + [row]
+            self._emit_reload("add")
+            logger.info("reload: model %r ready", spec.name)
+
+        # the default re-resolves against the NEW manifest BEFORE any
+        # removal, so there is no instant with a dangling default
+        self.default_model = default
+        self.model_name = default
+
+        # -- phase 2: removals (drain, then release) ----------------------
+        drained: list[dict] = []
+        for name in removed:
+            with self._lock:
+                eng = self._engines.pop(name)
+                self._specs.pop(name, None)
+            self._set_state(name, "draining")
+            deadline = time.time() + drain_seconds
+            # in-flight requests on the removed model finish (new ones
+            # already 400 — the alias left the routing table above)
+            while self.inflight(name) and time.time() < deadline:
+                time.sleep(0.05)
+            stranded = self.inflight(name)
+            if stranded:
+                logger.warning(
+                    "reload: removing %r with %d request(s) still "
+                    "in flight after the %.0fs drain budget", name,
+                    stranded, drain_seconds)
+            # retire the radix namespace: pages freed (never evicted
+            # cross-namespace), polled until in-flight leases release
+            pool = getattr(eng, "_kvpool", None)
+            remaining = 0
+            if pool is not None and hasattr(pool, "drain_namespace"):
+                remaining = pool.drain_namespace(name)
+                while remaining and time.time() < deadline:
+                    time.sleep(0.05)
+                    remaining = pool.drain_namespace(name)
+            if hasattr(eng, "shutdown"):
+                eng.shutdown()
+            with self._lock:
+                self._model_info = [r for r in self._model_info
+                                    if r["name"] != name]
+            self._emit_reload("remove")
+            drained.append({"name": name, "pages_remaining": remaining,
+                            "inflight_at_release": stranded})
+            logger.info("reload: model %r removed (namespace drained, "
+                        "%d pages remaining)", name, remaining)
+
+        return {
+            "added": [s.name for s in added],
+            "removed": drained,
+            "kept": sorted(n for n in new_names
+                           if n not in {s.name for s in added}),
+            "default_model": self.default_model,
+            "models": self.models(),
+            "wall_s": round(time.time() - t0, 3),
+        }
+
+    def _live_weight_bytes(self) -> int:
+        with self._lock:
+            return sum(r["weight_bytes"] for r in self._model_info
+                       if r["state"] == "ready")
+
+    def _shared_pool(self):
+        """The pool new engines should join: the fleet's first live pool
+        (build degrades geometry-incompatible engines to a private pool,
+        exactly like the startup path)."""
+        pools = self._pools()
+        return pools[0] if pools else None
 
     # -- telemetry fan-in/out -------------------------------------------
     @property

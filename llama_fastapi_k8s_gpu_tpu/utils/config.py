@@ -237,6 +237,36 @@ class Settings:
     # slow wire blocks the prefill tier's page export, never grows memory;
     # the buffered bytes are the memory ledger's disagg_txbuf component)
     disagg_queue_frames: int = 32
+    # -- fleet tier (serving/fleet/; docs/RUNBOOK.md "Running a replica
+    # fleet") --------------------------------------------------------------
+    # "router" turns this process into the prefix-affinity proxy in front
+    # of the replica fleet (no engine, no jax): requests key on their
+    # conversation/system-prompt prefix and rendezvous-hash to the
+    # replica whose radix cache is warm for them.  "off" (default) = a
+    # plain serving replica.
+    fleet_role: str = "off"
+    # router: static replica list, host:port comma-separated (tests,
+    # docker-compose).  In k8s prefer fleet_dns.
+    fleet_peers: str = ""
+    # router: headless-Service DNS name:port, re-resolved every probe
+    # cycle — one A record per ready pod, so scale-out/in needs no
+    # router restart
+    fleet_dns: str = ""
+    # router placement policy: "affinity" (rendezvous on the prefix key)
+    # or "roundrobin" (the A/B control arm — bench_server.py fleet arm)
+    fleet_policy: str = "affinity"
+    # router: peer /health/ready probe period
+    fleet_probe_seconds: float = 2.0
+    # router: first ejection backoff (doubles per consecutive failure)
+    fleet_eject_backoff_seconds: float = 1.0
+    fleet_eject_backoff_max: float = 30.0
+    # router: backend connect + response-head deadline; body progress
+    # rides stream_deadline_seconds
+    fleet_proxy_timeout_seconds: float = 5.0
+    # live manifest reload (POST /admin/models/reload, SIGHUP): bounded
+    # wait for a removed model's in-flight requests and its radix
+    # namespace's pinned pages before the weights release
+    reload_drain_seconds: float = 30.0
 
     @property
     def model_path(self) -> str:
@@ -370,6 +400,32 @@ KNOBS: dict[str, Knob] = _register(
          "local prefill"),
     Knob("LFKT_DISAGG_QUEUE_FRAMES", int,
          "bounded page-frame send queue per peer (backpressure)"),
+    # -- fleet tier (serving/fleet/) ---------------------------------------
+    Knob("LFKT_FLEET_ROLE", str,
+         "off|router — router runs the prefix-affinity proxy over the "
+         "replica fleet instead of a serving engine (serving/fleet/)",
+         serving=True),
+    Knob("LFKT_FLEET_PEERS", str,
+         "router: static replica list host:port[,host:port...]",
+         serving=True),
+    Knob("LFKT_FLEET_DNS", str,
+         "router: headless-Service name:port resolved per probe cycle "
+         "(one A record per ready replica)", serving=True),
+    Knob("LFKT_FLEET_POLICY", str,
+         "router placement: affinity (rendezvous on the prefix key) | "
+         "roundrobin (A/B control)", serving=True),
+    Knob("LFKT_FLEET_PROBE_SECONDS", float,
+         "router: peer /health/ready probe period", serving=True),
+    Knob("LFKT_FLEET_EJECT_BACKOFF_SECONDS", float,
+         "router: first ejection backoff (doubles per failure)"),
+    Knob("LFKT_FLEET_EJECT_BACKOFF_MAX", float,
+         "router: ejection backoff ceiling"),
+    Knob("LFKT_FLEET_PROXY_TIMEOUT_SECONDS", float,
+         "router: backend connect + response-head deadline",
+         serving=True),
+    Knob("LFKT_RELOAD_DRAIN_SECONDS", float,
+         "live model removal: bounded wait for in-flight requests + "
+         "pinned namespace pages before weights release", serving=True),
     # -- ad-hoc knobs (read via knob()/env_bool(), not Settings) -----------
     Knob("LFKT_HOST", str, "bind address (server/__main__.py)",
          default="0.0.0.0"),

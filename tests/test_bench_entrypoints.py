@@ -223,6 +223,39 @@ def test_bench_server_disagg_smoke():
     assert svc["bytes_sent"] > 0
 
 
+def test_bench_server_fleet_smoke():
+    """The fleet arm (LFKT_BENCH_FLEET=1): two in-process paged replicas
+    behind the real prefix-affinity router, the affinity replay vs the
+    round-robin control — one valid provenance-stamped JSON line where
+    the affinity phase genuinely reused cache (hit ratio > 0) and beat
+    (or at worst matched) the control (serving/fleet/)."""
+    parsed, out = _run("bench_server.py",
+                       extra_env={"LFKT_BENCH_FLEET": "1",
+                                  "LFKT_BENCH_CONVS": "3",
+                                  "LFKT_BENCH_TURNS": "3",
+                                  "LFKT_BENCH_MAX_TOKENS": "8",
+                                  "LFKT_BENCH_PORT": "8047"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "fleet_prefix_hit_ratio" in parsed["metric"]
+    aff, ctl = parsed["affinity"], parsed["control"]
+    assert aff["policy"] == "affinity"
+    assert ctl["policy"] == "roundrobin"
+    # the affinity phase reused cached prefixes and never erred
+    assert parsed["value"] > 0
+    assert aff["hit_ratio_tokens"] == parsed["value"]
+    assert aff["errors"] == [] and ctl["errors"] == [], (aff, ctl)
+    assert aff["warm_samples"] > 0 and ctl["warm_samples"] > 0
+    assert aff["warm_ttft_ms_p50"] > 0
+    # both replicas actually took traffic in both phases
+    for phase in (aff, ctl):
+        assert len(phase["per_replica"]) == 2
+        assert all(r["prompt_tokens"] > 0 for r in phase["per_replica"])
+    # the A/B direction: affinity >= control (the decisive >= 2x margin
+    # is pinned by the two-process drill in tests/test_fleet.py; tiny
+    # prompts + page flooring make this smoke directional only)
+    assert aff["hit_ratio_tokens"] >= ctl["hit_ratio_tokens"], parsed
+
+
 def test_bench_server_batch_multiturn_smoke():
     """The lane-prefix A/B mode (LFKT_BENCH_MULTITURN x LFKT_BENCH_BATCH)
     must emit valid JSON with complete conversations and the engine-level

@@ -1,0 +1,812 @@
+"""Fleet tier: prefix-affinity router + peer table (ISSUE 14;
+serving/fleet/).
+
+Layers, all tier-1 on CPU:
+
+1. **Units** — affinity-key extraction (stable per conversation, header
+   override, opaque fallback), rendezvous ranking (balance + minimal
+   remap on peer loss), peer-table ejection/backoff/re-admission
+   against a controllable fake replica.
+2. **In-process router** — FakeEngine replicas behind the real router
+   over real TCP: affinity stickiness, the round-robin control arm,
+   ejection → spill-to-survivor with /health attribution and recovery.
+3. **Route parity** (the ci_gate ``fleet-route-parity`` subset) — real
+   tiny-GGUF replicas: greedy ``/response`` bytes and ``/v1`` content
+   through the router are identical to direct-to-replica serving,
+   streaming included.
+4. **Two-process acceptance drill** — two real server processes behind
+   the router: the multi-turn replay's aggregate prefix-cache hit
+   ratio under affinity routing is >= 2x the round-robin control,
+   SIGKILLing a replica mid-stream ejects it (attributed, stream
+   terminates, fresh traffic spills to the survivor) and restarting it
+   re-admits it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import http.server
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.engine import Engine, FakeEngine
+from llama_fastapi_k8s_gpu_tpu.server import httpd
+from llama_fastapi_k8s_gpu_tpu.server.app import create_app
+from llama_fastapi_k8s_gpu_tpu.serving.fleet import FLEET_ROLES, build_router
+from llama_fastapi_k8s_gpu_tpu.serving.fleet.affinity import (
+    AFFINITY_HEADER,
+    affinity_key,
+    rendezvous_rank,
+)
+from llama_fastapi_k8s_gpu_tpu.serving.fleet.peers import PeerTable
+from llama_fastapi_k8s_gpu_tpu.serving.fleet.router import FleetRouter
+from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+from llama_fastapi_k8s_gpu_tpu.utils.config import Settings
+from llama_fastapi_k8s_gpu_tpu.utils.metrics import Metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _body(conv: int, history: list | None = None,
+          opener: str = "hello") -> bytes:
+    return json.dumps({
+        "bot_profile": {
+            "name": f"Bot{conv}",
+            "appearance": "tall, green eyes, red hair, calm voice",
+            "system_prompt": f"You are concise assistant #{conv}.",
+        },
+        "user_profile": {"name": "Sam"},
+        "context": history or [{"turn": "user",
+                                "message": f"{opener} {conv}"}],
+    }).encode()
+
+
+def _post(port: int, body: bytes, path: str = "/response",
+          timeout: float = 60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _get_json(port: int, path: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_http(port: int, path: str = "/health",
+               deadline_s: float = 180.0) -> None:
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5)
+            return
+        except Exception:  # noqa: BLE001 — booting
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+# ---------------------------------------------------------------------------
+# in-process serving helpers (graceful-stoppable httpd + router threads)
+# ---------------------------------------------------------------------------
+
+class _Served:
+    """One asyncio server (httpd app or router) on its own loop thread,
+    stoppable from the test thread."""
+
+    def __init__(self, coro_factory):
+        self._loop = None
+        self._stop = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        args=(coro_factory,), daemon=True)
+        self._thread.start()
+        assert self._started.wait(10)
+
+    def _run(self, coro_factory):
+        async def main():
+            self._loop = asyncio.get_running_loop()
+            self._stop = asyncio.Event()
+            self._started.set()
+            await coro_factory(self._stop)
+        asyncio.run(main())
+
+    def stop(self, join_s: float = 15.0):
+        if self._loop is not None and self._stop is not None:
+            try:
+                self._loop.call_soon_threadsafe(self._stop.set)
+            except RuntimeError:
+                pass
+        self._thread.join(timeout=join_s)
+
+
+def _serve_app(engine, port: int, **settings_kw) -> _Served:
+    settings_kw.setdefault("watchdog", False)
+    settings_kw.setdefault("temperature", 0.0)
+    app = create_app(engine=engine, settings=Settings(**settings_kw))
+    srv = _Served(lambda stop: httpd.serve(app, "127.0.0.1", port,
+                                           stop_event=stop))
+    _wait_http(port)
+    return srv
+
+
+def _serve_router(router: FleetRouter, port: int) -> _Served:
+    srv = _Served(lambda stop: router.serve("127.0.0.1", port,
+                                            stop_event=stop))
+    _wait_http(port, path="/health")
+    return srv
+
+
+def _table(ports, **kw) -> PeerTable:
+    kw.setdefault("probe_seconds", 0.3)
+    kw.setdefault("backoff_seconds", 0.3)
+    kw.setdefault("probe_timeout", 2.0)
+    return PeerTable(peers=[f"127.0.0.1:{p}" for p in ports], **kw)
+
+
+# ---------------------------------------------------------------------------
+# layer 1: units
+# ---------------------------------------------------------------------------
+
+def test_affinity_key_sources():
+    # explicit header wins over everything
+    k, src = affinity_key("/response", {AFFINITY_HEADER: "conv-42"},
+                          _body(0))
+    assert (k, src) == ("h:conv-42", "header")
+
+    # /response: stable across turns of one conversation (the persona +
+    # the FIRST user message key it), distinct across conversations
+    k1, src1 = affinity_key("/response", {}, _body(1))
+    grown = [{"turn": "user", "message": "hello 1"},
+             {"turn": "bot", "message": "hi!"},
+             {"turn": "user", "message": "tell me more"}]
+    k1b, _ = affinity_key("/response", {}, _body(1, history=grown))
+    assert src1 == "prefix" and k1 == k1b
+    k2, _ = affinity_key("/response", {}, _body(2))
+    assert k2 != k1
+
+    # /v1: the OpenAI user field is the conversation id when present
+    v1 = {"model": "m", "user": "u-7",
+          "messages": [{"role": "user", "content": "x"}]}
+    k3, src3 = affinity_key("/v1/chat/completions", {},
+                            json.dumps(v1).encode())
+    assert (k3, src3) == ("u:u-7", "conversation")
+    # ... else the stable message prefix
+    v2 = {"model": "m", "messages": [
+        {"role": "system", "content": "be terse"},
+        {"role": "user", "content": "first question"}]}
+    k4, src4 = affinity_key("/v1/chat/completions", {},
+                            json.dumps(v2).encode())
+    v2["messages"].append({"role": "assistant", "content": "answer"})
+    v2["messages"].append({"role": "user", "content": "follow-up"})
+    k4b, _ = affinity_key("/v1/chat/completions", {},
+                          json.dumps(v2).encode())
+    assert src4 == "prefix" and k4 == k4b
+
+    # unparseable body: deterministic opaque digest (retries co-locate)
+    k5, src5 = affinity_key("/response", {}, b"\xff not json")
+    k5b, _ = affinity_key("/response", {}, b"\xff not json")
+    assert src5 == "opaque" and k5 == k5b
+    # bodyless GET: keyed on the path
+    k6, src6 = affinity_key("/v1/models", {}, b"")
+    assert src6 == "opaque" and k6 == affinity_key("/v1/models", {}, b"")[0]
+
+
+def test_rendezvous_rank_balance_and_minimal_remap():
+    peers = ["10.0.0.1:8000", "10.0.0.2:8000", "10.0.0.3:8000"]
+    keys = [f"conv-{i}" for i in range(300)]
+    owners = {k: rendezvous_rank(k, peers)[0] for k in keys}
+    counts = {p: sum(1 for o in owners.values() if o == p) for p in peers}
+    # roughly balanced: every peer owns a healthy share
+    assert all(c > 50 for c in counts.values()), counts
+    # stability: ranking is deterministic
+    assert owners == {k: rendezvous_rank(k, peers)[0] for k in keys}
+    # removing one peer remaps ONLY its keys (the HRW property the
+    # warm-cache story depends on: a dead pod must not reshuffle every
+    # conversation in the fleet)
+    survivors = peers[:2]
+    for k in keys:
+        if owners[k] in survivors:
+            assert rendezvous_rank(k, survivors)[0] == owners[k]
+    # spill order: dropping the owner promotes exactly rank-2
+    for k in keys[:50]:
+        full = rendezvous_rank(k, peers)
+        assert rendezvous_rank(
+            k, [p for p in peers if p != full[0]])[0] == full[1]
+
+
+class _FlagReplica:
+    """A controllable /health/ready endpoint: 200 while .ready, else 503."""
+
+    def __init__(self):
+        self.ready = True
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):           # noqa: N802 — stdlib contract
+                code = 200 if outer.ready else 503
+                body = b'{"ready": true}' if outer.ready else b'{}'
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_peer_table_eject_backoff_readmit():
+    rep = _FlagReplica()
+    table = _table([rep.port])
+    try:
+        table.start(probe_now=True)
+        addr = f"127.0.0.1:{rep.port}"
+        assert table.healthy() == [addr]
+
+        # replica turns not-ready: the next sweep ejects with attribution
+        rep.ready = False
+        deadline = time.time() + 10
+        while table.healthy() and time.time() < deadline:
+            time.sleep(0.05)
+        assert table.healthy() == []
+        snap = table.snapshot()
+        assert snap["healthy"] == 0 and snap["replicas"] == 1
+        row = snap["peers"][0]
+        assert row["healthy"] is False
+        assert "503" in row["last_error"]
+        assert row["ejections"] >= 1
+
+        # backoff grows while it stays down (bounded probing)
+        time.sleep(1.2)
+        b1 = table.snapshot()["peers"][0]["backoff_seconds"]
+        assert b1 >= 0.3
+
+        # recovery: ready again -> re-admitted without operator action
+        rep.ready = True
+        deadline = time.time() + 10
+        while not table.healthy() and time.time() < deadline:
+            time.sleep(0.05)
+        assert table.healthy() == [addr]
+        assert table.snapshot()["peers"][0]["last_error"] is None
+    finally:
+        table.stop()
+        rep.close()
+
+
+def test_probe_survives_non_http_peer():
+    """A port answering non-HTTP (half-dead process, wrong service) must
+    eject with attribution — never crash the sweep (or router startup)
+    that the REST of the fleet depends on."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def accept_loop():
+        while True:
+            try:
+                c, _addr = srv.accept()
+            except OSError:
+                return
+            try:
+                c.sendall(b"NOT HTTP AT ALL\n")
+                c.close()
+            except OSError:
+                pass
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    table = PeerTable(peers=[f"127.0.0.1:{port}"], probe_seconds=0.2,
+                      backoff_seconds=0.2, probe_timeout=1.0)
+    try:
+        table.start(probe_now=True)          # must not raise
+        assert table.healthy() == []
+        err = table.snapshot()["peers"][0]["last_error"]
+        assert "BadStatusLine" in err, err
+    finally:
+        table.stop()
+        srv.close()
+
+
+def test_peer_table_validation_and_roles():
+    with pytest.raises(ValueError, match="LFKT_FLEET_PEERS"):
+        PeerTable(peers=[], dns="")
+    assert FLEET_ROLES == ("off", "router")
+    with pytest.raises(ValueError, match="LFKT_FLEET_POLICY"):
+        FleetRouter(object(), policy="sideways")
+
+
+def test_build_router_from_settings():
+    rep = _FlagReplica()
+    try:
+        router = build_router(Settings(
+            fleet_peers=f"127.0.0.1:{rep.port}", fleet_policy="roundrobin",
+            fleet_probe_seconds=0.3, fleet_proxy_timeout_seconds=2.0))
+        assert router.policy == "roundrobin"
+        assert router.peers.healthy() == [f"127.0.0.1:{rep.port}"]
+        router.peers.stop()
+    finally:
+        rep.close()
+
+
+# ---------------------------------------------------------------------------
+# layer 2: the router over FakeEngine replicas
+# ---------------------------------------------------------------------------
+
+def test_router_affinity_sticks_roundrobin_spreads():
+    p1, p2, rp, rp2 = (_free_port() for _ in range(4))
+    s1 = _serve_app(FakeEngine(reply="alpha"), p1)
+    s2 = _serve_app(FakeEngine(reply="beta"), p2)
+    table = _table([p1, p2]).start()
+    router = FleetRouter(table, policy="affinity", metrics=Metrics())
+    rs = _serve_router(router, rp)
+    table2 = _table([p1, p2]).start()
+    rr = FleetRouter(table2, policy="roundrobin")
+    rs2 = _serve_router(rr, rp2)
+    try:
+        # affinity: each conversation sticks to ONE replica...
+        seen = {}
+        for conv in range(6):
+            answers = set()
+            for _ in range(3):
+                _status, raw = _post(rp, _body(conv))
+                answers.add(json.loads(raw)["response"])
+            assert len(answers) == 1, (conv, answers)
+            seen[conv] = answers.pop()
+        # ... and the keyspace uses BOTH replicas
+        assert set(seen.values()) == {"alpha", "beta"}
+
+        # round-robin control: consecutive turns of ONE conversation
+        # scatter (the cold-cache failure mode the affinity policy fixes)
+        answers = set()
+        for _ in range(4):
+            _status, raw = _post(rp2, _body(0))
+            answers.add(json.loads(raw)["response"])
+        assert answers == {"alpha", "beta"}
+
+        # the router /metrics carries the fleet families
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rp}/metrics", timeout=10) as r:
+            m = r.read().decode()
+        assert "fleet_requests_total" in m
+        assert "fleet_peers_healthy 2" in m
+        assert 'source="prefix"' in m
+    finally:
+        rs.stop()
+        rs2.stop()
+        table.stop()
+        table2.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_router_ejects_spills_attributes_and_readmits():
+    p1, p2, rp = (_free_port() for _ in range(3))
+    s1 = _serve_app(FakeEngine(reply="alpha"), p1)
+    s2 = _serve_app(FakeEngine(reply="beta"), p2)
+    table = _table([p1, p2]).start()
+    router = FleetRouter(table, policy="affinity", metrics=Metrics())
+    rs = _serve_router(router, rp)
+    try:
+        # find a conversation owned by replica 1 (alpha)
+        conv = next(c for c in range(64)
+                    if json.loads(_post(rp, _body(c))[1])["response"]
+                    == "alpha")
+
+        # kill replica 1 (graceful stop: the port refuses connections)
+        s1.stop()
+        # a fresh request for the SAME conversation must spill to the
+        # survivor — never a hang, never a 502/503
+        status, raw = _post(rp, _body(conv))
+        assert status == 200
+        assert json.loads(raw)["response"] == "beta"
+        assert router.counters["spills"] >= 1
+
+        # the router's /health attributes the ejected peer by name
+        doc = _get_json(rp, "/health")
+        assert doc["role"] == "router" and doc["healthy"] == 1
+        dead = [p for p in doc["peers"] if not p["healthy"]]
+        assert len(dead) == 1
+        assert dead[0]["addr"] == f"127.0.0.1:{p1}"
+        assert dead[0]["last_error"]
+        # /health/ready stays 200 while >= 1 replica lives
+        assert _get_json(rp, "/health/ready")["ready"] is True
+
+        # recovery: the replica comes back on the same port -> the
+        # prober re-admits it and affinity returns home
+        s1b = _serve_app(FakeEngine(reply="alpha"), p1)
+        try:
+            deadline = time.time() + 15
+            while len(table.healthy()) < 2 and time.time() < deadline:
+                time.sleep(0.1)
+            assert len(table.healthy()) == 2
+            _status, raw = _post(rp, _body(conv))
+            assert json.loads(raw)["response"] == "alpha"
+        finally:
+            s1b.stop()
+    finally:
+        rs.stop()
+        table.stop()
+        s2.stop()
+
+
+def test_router_503_with_attribution_when_whole_fleet_down():
+    p1, rp = _free_port(), _free_port()
+    table = PeerTable(peers=[f"127.0.0.1:{p1}"], probe_seconds=0.3,
+                      backoff_seconds=0.3, probe_timeout=1.0)
+    table.start()            # nothing listening: probe ejects immediately
+    router = FleetRouter(table, policy="affinity",
+                         proxy_timeout=1.0)
+    rs = _serve_router(router, rp)
+    try:
+        t0 = time.time()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(rp, _body(0), timeout=15)
+        assert ei.value.code == 503
+        assert "no healthy replica" in ei.value.read().decode()
+        assert time.time() - t0 < 10      # bounded, never a hang
+        # the router's OWN readiness flips 503 while the fleet is down,
+        # so k8s stops routing clients at it
+        with pytest.raises(urllib.error.HTTPError) as rei:
+            _get_json(rp, "/health/ready")
+        assert rei.value.code == 503
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rp}/health", timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["healthy"] == 0
+        assert doc["counters"]["no_replica_503s"] >= 1
+    finally:
+        rs.stop()
+        table.stop()
+
+
+# ---------------------------------------------------------------------------
+# layer 3: route parity on real engines (the ci_gate subset)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gguf_path(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("fleet") / "tiny.gguf")
+    write_tiny_llama_gguf(p)
+    return p
+
+
+def _tiny_engine(path):
+    return Engine(path, n_ctx=256, prefill_buckets=(64, 128),
+                  max_gen_tokens=8, decode_chunk=4, kv_paged=True,
+                  kv_page_tokens=16)
+
+
+def test_fleet_route_parity(gguf_path):
+    """Greedy output THROUGH the router is byte-identical to direct
+    serving — /response raw body bytes, /v1 content + usage, and the
+    streamed SSE content — on real engines (same GGUF on both replicas,
+    so whichever replica owns the key answers identically)."""
+    p1, p2, rp = (_free_port() for _ in range(3))
+    s1 = _serve_app(_tiny_engine(gguf_path), p1)
+    s2 = _serve_app(_tiny_engine(gguf_path), p2)
+    table = _table([p1, p2]).start()
+    router = FleetRouter(table, policy="affinity")
+    rs = _serve_router(router, rp)
+    try:
+        body = _body(0, opener="The quick brown fox jumps over")
+        _st, direct = _post(p1, body, timeout=300)
+        _st, routed = _post(rp, body, timeout=300)
+        assert routed == direct          # BYTE identity, whole body
+
+        # /v1 facade: deterministic fields match (id/created are minted
+        # per request, so compare the generation, not the envelope)
+        v1 = json.dumps({
+            "model": None, "temperature": 0.0, "max_tokens": 8,
+            "messages": [{"role": "user",
+                          "content": "Say something about foxes."}],
+        }).encode()
+        _st, d_raw = _post(p1, v1, path="/v1/chat/completions",
+                           timeout=300)
+        _st, r_raw = _post(rp, v1, path="/v1/chat/completions",
+                           timeout=300)
+        d_doc, r_doc = json.loads(d_raw), json.loads(r_raw)
+        assert r_doc["choices"] == d_doc["choices"]
+        assert r_doc["usage"] == d_doc["usage"]
+
+        # streaming passthrough: the routed SSE stream concatenates to
+        # the same greedy text
+        def stream_text(port):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/response/stream", data=body,
+                headers={"Content-Type": "application/json"})
+            parts = []
+            with urllib.request.urlopen(req, timeout=300) as r:
+                for raw in r:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data:"):
+                        continue
+                    payload = line[5:].strip()
+                    if payload == "[DONE]":
+                        break
+                    evt = json.loads(payload)
+                    assert "error" not in evt, evt
+                    c = evt["choices"][0]["delta"].get("content")
+                    if c:
+                        parts.append(c)
+            return "".join(parts)
+
+        assert stream_text(rp) == stream_text(p1)
+    finally:
+        rs.stop()
+        table.stop()
+        s1.stop()
+        s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# layer 4: the two-process acceptance drill
+# ---------------------------------------------------------------------------
+
+def _proc_env(port: int, model_dir: str, **extra) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "LFKT_MODEL_DIR": model_dir,
+        "LFKT_MODEL_NAME": "tiny.gguf",
+        "LFKT_HOST": "127.0.0.1",
+        "LFKT_PORT": str(port),
+        # buckets sized for 3 turns of growing history (the replay) with
+        # 8-token replies: turn-3 prompts land in the 256 bucket
+        "LFKT_MAX_CONTEXT_TOKENS": "512",
+        "LFKT_PREFILL_BUCKETS": "64,128,256",
+        "LFKT_MAX_GEN_TOKENS": "8",
+        "LFKT_DECODE_CHUNK": "4",
+        "LFKT_TEMPERATURE": "0.0",
+        "LFKT_KV_PAGED": "1",
+        "LFKT_KV_PAGE_TOKENS": "16",
+    })
+    env.update({k: str(v) for k, v in extra.items()})
+    env.pop("XLA_FLAGS", None)   # one CPU device per serving replica
+    return env
+
+
+def _spawn_replica(port: int, model_dir: str, **extra):
+    return subprocess.Popen(
+        [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.server"],
+        env=_proc_env(port, model_dir, **extra), cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def _wait_proc_ready(proc, port: int, deadline: float) -> None:
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"server :{port} died:\n"
+                f"{proc.stderr.read().decode()[-3000:]}")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=5) as r:
+                if r.status == 200:
+                    return
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(1.0)
+    raise AssertionError(f"server :{port} not healthy before deadline")
+
+
+def _metric_sum(port: int, name: str) -> float:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    total = 0.0
+    for ln in text.splitlines():
+        head, _, val = ln.rpartition(" ")
+        if head == name or head.startswith(name + "{"):
+            total += float(val)
+    return total
+
+
+def _fleet_ratio(ports) -> tuple[float, dict]:
+    """(token-weighted prefix hit ratio, raw counters) across replicas:
+    reused prompt tokens / submitted prompt tokens — the fraction of
+    prompt work served from cached KV pages."""
+    raw = {"reused": 0.0, "prompt": 0.0, "hits": 0.0, "misses": 0.0}
+    for p in ports:
+        raw["reused"] += _metric_sum(p, "prefix_cache_reused_tokens_total")
+        raw["prompt"] += _metric_sum(p, "tokens_prompt_total")
+        raw["hits"] += _metric_sum(p, "prefix_cache_hits_total")
+        raw["misses"] += _metric_sum(p, "prefix_cache_misses_total")
+    return (raw["reused"] / raw["prompt"] if raw["prompt"] else 0.0), raw
+
+
+def _replay(router_port: int, convs: list, turns: int,
+            phase: str) -> None:
+    """C growing conversations x T turns, round-robin ACROSS
+    conversations per turn (the k8s traffic shape: consecutive requests
+    belong to different users)."""
+    histories = {
+        c: [{"turn": "user",
+             "message": f"[{phase}] Hello bot {c}! The quick brown fox "
+                        "jumps over the lazy dog near the riverbank "
+                        "while autumn leaves drift slowly down."}]
+        for c in convs
+    }
+    for _t in range(turns):
+        for c in convs:
+            _status, raw = _post(router_port,
+                                 _body(c, history=histories[c]),
+                                 timeout=300)
+            reply = json.loads(raw)["response"]
+            histories[c].append({"turn": "bot",
+                                 "message": (reply or "...")[:400]})
+            histories[c].append({"turn": "user",
+                                 "message": "Please tell me more."})
+
+
+def test_two_process_affinity_and_fault_drill(tmp_path):
+    """THE acceptance drill: 2 real replica processes behind the router.
+
+    (a) multi-turn replay under affinity routing reaches >= 2x the
+        aggregate prefix-cache hit ratio of the round-robin control
+        (same processes, fresh conversations, counter deltas);
+    (b) greedy output through the router is bit-identical to direct;
+    (c) SIGKILL a replica mid-stream: the stream terminates (no hang),
+        the router ejects the peer with /health attribution, fresh
+        requests land on the survivor;
+    (d) restarting the replica re-admits it.
+    """
+    write_tiny_llama_gguf(str(tmp_path / "tiny.gguf"))
+    p1, p2 = 8065, 8066
+    rp_aff, rp_rr = _free_port(), _free_port()
+
+    proc1 = _spawn_replica(p1, str(tmp_path))
+    proc2 = _spawn_replica(p2, str(tmp_path))
+    table = table_rr = rs = rs_rr = None
+    try:
+        deadline = time.time() + 420
+        _wait_proc_ready(proc1, p1, deadline)
+        _wait_proc_ready(proc2, p2, deadline)
+
+        table = _table([p1, p2]).start()
+        rs = _serve_router(FleetRouter(table, policy="affinity"), rp_aff)
+        table_rr = _table([p1, p2]).start()
+        rs_rr = _serve_router(FleetRouter(table_rr, policy="roundrobin"),
+                              rp_rr)
+
+        # (b) parity first, while both replicas are pristine
+        body = _body(99, opener="The quick brown fox jumps over the "
+                                "lazy dog near the old riverbank ok")
+        _st, direct = _post(p1, body, timeout=300)
+        _st, routed = _post(rp_aff, body, timeout=300)
+        assert routed == direct
+
+        # (a) affinity replay vs round-robin control, by counter deltas.
+        # 3 conversations (ODD: an even count over 2 replicas makes
+        # round-robin accidentally affine), 3 turns.
+        base = _fleet_ratio((p1, p2))[1]
+        _replay(rp_aff, [0, 1, 2], turns=3, phase="aff")
+        mid = _fleet_ratio((p1, p2))[1]
+        _replay(rp_rr, [10, 11, 12], turns=3, phase="rr")
+        end = _fleet_ratio((p1, p2))[1]
+
+        def delta(a, b):
+            d = {k: b[k] - a[k] for k in a}
+            return (d["reused"] / d["prompt"] if d["prompt"] else 0.0), d
+
+        aff_ratio, aff_raw = delta(base, mid)
+        rr_ratio, rr_raw = delta(mid, end)
+        assert aff_ratio > 0.3, (aff_ratio, aff_raw)
+        assert aff_ratio >= 2.0 * rr_ratio, (
+            f"affinity hit ratio {aff_ratio:.3f} not >= 2x round-robin "
+            f"control {rr_ratio:.3f} (aff={aff_raw}, rr={rr_raw})")
+
+        # (c) SIGKILL a replica mid-stream through the affinity router
+        victim_conv = 0
+        # the replica that served conversation 0's turns is its owner;
+        # find it from the per-replica request counters
+        doc = _get_json(rp_aff, "/health")
+        assert doc["healthy"] == 2
+        stream_req = urllib.request.Request(
+            f"http://127.0.0.1:{rp_aff}/response/stream",
+            data=_body(victim_conv, opener="[kill] please tell a story"),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(stream_req, timeout=60)
+        first = resp.readline()          # stream is live
+        assert first is not None
+        # which process owns conv 0? ask the router's rank via affinity
+        key, _src = affinity_key(
+            "/response/stream", {},
+            _body(victim_conv, opener="[kill] please tell a story"))
+        owner = rendezvous_rank(key, [f"127.0.0.1:{p1}",
+                                      f"127.0.0.1:{p2}"])[0]
+        victim, survivor_port = ((proc1, p2)
+                                 if owner == f"127.0.0.1:{p1}"
+                                 else (proc2, p1))
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=30)
+        # the stream TERMINATES (error event, truncation, or closed
+        # socket) within a bound — never a hang
+        t0 = time.time()
+        try:
+            while resp.readline():
+                pass
+        except Exception:  # noqa: BLE001 — torn connection is a valid end
+            pass
+        assert time.time() - t0 < 30
+        resp.close()
+
+        # fresh requests for the dead owner's conversations spill to the
+        # survivor and answer 200
+        status, raw = _post(rp_aff, _body(victim_conv,
+                                          opener="[kill] and now?"),
+                            timeout=300)
+        assert status == 200 and json.loads(raw)["response"]
+        # the ejection is attributed on the router's health doc
+        doc = _get_json(rp_aff, "/health")
+        assert doc["healthy"] == 1
+        dead_rows = [p for p in doc["peers"] if not p["healthy"]]
+        assert len(dead_rows) == 1 and dead_rows[0]["last_error"]
+        assert dead_rows[0]["addr"] == owner
+
+        # (d) recovery: restart the victim on its port -> re-admission
+        dead_port = int(owner.rsplit(":", 1)[1])
+        revived = _spawn_replica(dead_port, str(tmp_path))
+        try:
+            _wait_proc_ready(revived, dead_port, time.time() + 420)
+            deadline = time.time() + 30
+            while _get_json(rp_aff, "/health")["healthy"] < 2 \
+                    and time.time() < deadline:
+                time.sleep(0.5)
+            assert _get_json(rp_aff, "/health")["healthy"] == 2
+            # ... and its conversations route home again
+            status, _raw = _post(rp_aff,
+                                 _body(victim_conv,
+                                       opener="[kill] welcome back"),
+                                 timeout=300)
+            assert status == 200
+            assert _metric_sum(survivor_port, "http_requests_total") > 0
+        finally:
+            if revived.poll() is None:
+                revived.terminate()
+            try:
+                revived.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                revived.kill()
+    finally:
+        for closer in (rs, rs_rr):
+            if closer is not None:
+                closer.stop()
+        for t in (table, table_rr):
+            if t is not None:
+                t.stop()
+        for p in (proc1, proc2):
+            if p.poll() is None:
+                p.terminate()
+        for p in (proc1, proc2):
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()

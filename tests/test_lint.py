@@ -378,7 +378,8 @@ def test_ci_gate_aggregates_lint_and_manifest():
     assert doc["ok"] is True
     names = {c["name"] for c in doc["checks"]}
     assert names == {"lfkt-lint", "check-manifest", "incident-schema",
-                     "disagg-wire-schema", "decode-loop-parity"}
+                     "disagg-wire-schema", "decode-loop-parity",
+                     "fleet-route-parity"}
     assert all(c["exit"] == 0 for c in doc["checks"])
 
 

@@ -129,7 +129,7 @@ def test_weight_budget_refusal_names_the_offender(ggufs):
     rows = reg.models()
     assert [r["name"] for r in rows] == ["alpha", "beta"]
     assert all(r["weight_bytes"] == per_model for r in rows)
-    assert all(r["state"] == "loaded" for r in rows)
+    assert all(r["state"] == "ready" for r in rows)
 
 
 # ---------------------------------------------------------------------------
@@ -449,7 +449,7 @@ async def test_health_models_block_and_metrics_labels(served_registry):
             rows = eng["models"]
             assert [r["name"] for r in rows] == ["alpha", "beta"]
             assert all(r["weight_bytes"] > 0 for r in rows)
-            assert all(r["state"] == "loaded" for r in rows)
+            assert all(r["state"] == "ready" for r in rows)
             assert all(r["quant"] for r in rows)
             assert eng["default_model"] == "alpha"
 

@@ -1,0 +1,415 @@
+"""Live manifest reload (ISSUE 14; serving/registry.py
+``reload_manifest`` + parallel/kvpool.py ``drain_namespace`` +
+``POST /admin/models/reload``).
+
+The correctness contract, each leg pinned here:
+
+- add-model under budget: loads, warms, turns routable, rows state
+  ``ready``;
+- ``WeightBudgetError`` refusal leaves the running set (and its rows)
+  untouched — no half-loaded fleet;
+- remove-model drains its radix namespace to ZERO pages through the
+  pool's drain path with no cross-namespace eviction storm (the
+  surviving tenant's warm pages are untouched, the eviction counter
+  does not move);
+- in-flight requests on a removed model finish; new ones 400 cleanly
+  with the live model list;
+- ``/v1/models`` and ``/health`` track the live set through the
+  transition (``loading|ready|draining`` states).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+import httpx
+import pytest
+
+from llama_fastapi_k8s_gpu_tpu.engine import Engine, FakeEngine
+from llama_fastapi_k8s_gpu_tpu.models.config import ModelConfig
+from llama_fastapi_k8s_gpu_tpu.parallel.kvpool import KVPool
+from llama_fastapi_k8s_gpu_tpu.server.app import create_app
+from llama_fastapi_k8s_gpu_tpu.serving import (
+    ModelRegistry,
+    UnknownModelError,
+    WeightBudgetError,
+    parse_manifest,
+)
+from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+from llama_fastapi_k8s_gpu_tpu.utils.config import Settings
+
+from tests.test_kvpool import CFG, T, marked_ring
+
+MSGS = [{"role": "user", "content": "The quick brown fox jumps over the "
+                                    "lazy dog near the old riverbank "
+                                    "while autumn leaves drift down."}]
+
+
+@pytest.fixture(scope="module")
+def ggufs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("reload")
+    paths = {}
+    for name, seed in (("a", 0), ("b", 7), ("c", 13)):
+        p = str(d / f"{name}.gguf")
+        write_tiny_llama_gguf(p, seed=seed)
+        paths[name] = p
+    return paths
+
+
+def _build(spec, path, shared_pool):
+    """The serial-engine twin of server/app.py's registry build: paged,
+    shared pool, per-model namespace."""
+    return Engine(path, n_ctx=256, prefill_buckets=(64, 128),
+                  max_gen_tokens=8, decode_chunk=4, kv_paged=True,
+                  kv_page_tokens=16, kv_pool=shared_pool,
+                  kv_namespace=spec.name)
+
+
+def _registry(ggufs, names=("alpha", "beta"), budget_bytes=0):
+    manifest = ",".join(f"{n}={ggufs[p]}" for n, p in
+                        zip(names, ("a", "b", "c")))
+    specs = parse_manifest(manifest)
+    return ModelRegistry.from_specs(
+        specs, _build, default_model=names[0],
+        weight_budget_bytes=budget_bytes)
+
+
+def _greedy(reg, model, n=8):
+    out = reg.create_chat_completion(MSGS, max_tokens=n, temperature=0.0,
+                                     model=model)
+    return out["choices"][0]["message"]["content"]
+
+
+# ---------------------------------------------------------------------------
+# the pool-level drain primitive
+# ---------------------------------------------------------------------------
+
+def test_drain_namespace_pinned_then_released():
+    """drain_namespace frees everything unpinned, reports the pinned
+    remainder, and converges to zero once the lease releases — without
+    touching the OTHER namespace."""
+    pool = KVPool(CFG, page_tokens=T, n_pages=8)
+    ring = marked_ring()
+    ids = list(range(1, 25))                  # 3 pages
+    assert pool.commit(ids, ring, namespace="doomed") == 3
+    assert pool.commit(list(range(100, 117)), ring,
+                       namespace="survivor") == 2
+    lease = pool.acquire(ids, 16, namespace="doomed")     # pin 2 pages
+    assert lease is not None
+
+    remaining = pool.drain_namespace("doomed")
+    # the lease pins 2 of the node's 3 pages; a partially pinned node
+    # holds ALL its pages until the lease releases (pages are freed per
+    # node, never torn out from under a restore)
+    assert remaining == 3
+    assert pool._ns_pages.get("survivor") == 2   # untouched
+    assert pool.counters["evictions"] == 0    # drain is NOT eviction
+
+    pool.release(lease)
+    assert pool.drain_namespace("doomed") == 0
+    assert "doomed" not in pool._roots
+    assert pool._ns_pages.get("doomed") is None
+    # survivor still fully matchable, its bytes never moved
+    assert pool.match_len(list(range(100, 117)),
+                          namespace="survivor") == 16
+    assert pool.counters["drained_pages"] == 3
+    # the freed pages are genuinely reusable
+    assert pool.occupancy()["pages_free"] == 8 - 2
+    assert pool.occupancy()["pages_pinned"] == 0
+
+
+def test_drain_namespace_spilled_and_absent():
+    pool = KVPool(CFG, page_tokens=T, n_pages=4, spill_pages=8)
+    ring = marked_ring()
+    pool.commit(list(range(1, 33)), ring, namespace="ns")   # fill arena
+    # force a spill by committing another namespace's pages
+    pool.commit(list(range(200, 217)), ring, namespace="other")
+    assert pool.counters["spills"] >= 1
+    assert pool.drain_namespace("ns") == 0    # spilled nodes drop too
+    assert "ns" not in pool._roots
+    assert pool.drain_namespace("never-existed") == 0
+
+
+# ---------------------------------------------------------------------------
+# registry reload: add / refuse / remove
+# ---------------------------------------------------------------------------
+
+def test_reload_add_under_budget(ggufs):
+    reg = _registry(ggufs)
+    try:
+        text_a = _greedy(reg, "alpha")
+        doc = reg.reload_manifest(
+            f"alpha={ggufs['a']},beta={ggufs['b']},gamma={ggufs['c']}")
+        assert doc["added"] == ["gamma"]
+        assert doc["removed"] == []
+        assert reg.model_names() == ["alpha", "beta", "gamma"]
+        rows = {r["name"]: r for r in reg.models()}
+        assert rows["gamma"]["state"] == "ready"
+        assert rows["gamma"]["weight_bytes"] > 0
+        # the new model serves; the old ones are bit-unchanged
+        assert _greedy(reg, "gamma")
+        assert _greedy(reg, "alpha") == text_a
+        # the new engine joined the SHARED pool under its own namespace
+        assert len(reg._pools()) == 1
+    finally:
+        reg.shutdown()
+
+
+def test_reload_budget_refusal_leaves_running_set_untouched(ggufs):
+    reg = _registry(ggufs)
+    try:
+        # budget: just what alpha+beta already use — gamma cannot fit
+        budget = sum(r["weight_bytes"] for r in reg.models()) + 1
+        reg._weight_budget_bytes = budget
+        with pytest.raises(WeightBudgetError, match="gamma"):
+            reg.reload_manifest(
+                f"alpha={ggufs['a']},beta={ggufs['b']},gamma={ggufs['c']}")
+        assert reg.model_names() == ["alpha", "beta"]
+        rows = {r["name"]: r for r in reg.models()}
+        assert set(rows) == {"alpha", "beta"}   # no leftover loading row
+        assert all(r["state"] == "ready" for r in rows.values())
+        assert _greedy(reg, "alpha")                # still serving
+    finally:
+        reg.shutdown()
+
+
+def test_reload_remove_drains_namespace_to_zero_no_storm(ggufs):
+    reg = _registry(ggufs)
+    try:
+        # serve traffic on BOTH models so both namespaces hold pages
+        _greedy(reg, "alpha")
+        _greedy(reg, "beta")
+        pool = reg._pools()[0]
+        alpha_pages = pool._ns_pages.get("alpha", 0)
+        beta_pages = pool._ns_pages.get("beta", 0)
+        assert alpha_pages > 0 and beta_pages > 0
+        evictions_before = pool.counters["evictions"]
+
+        doc = reg.reload_manifest(f"alpha={ggufs['a']}")
+        assert [r["name"] for r in doc["removed"]] == ["beta"]
+        assert doc["removed"][0]["pages_remaining"] == 0
+
+        # beta's namespace drained to zero pages, nothing pinned behind
+        assert pool._ns_pages.get("beta") is None
+        assert "beta" not in pool._roots
+        assert pool.occupancy()["pages_pinned"] == 0
+        # ... with NO cross-namespace eviction storm: alpha's warm pages
+        # are exactly where they were and the eviction counter never moved
+        assert pool._ns_pages.get("alpha", 0) == alpha_pages
+        assert pool.counters["evictions"] == evictions_before
+        assert pool.counters["drained_pages"] >= beta_pages
+
+        # routing reflects the removal
+        assert reg.model_names() == ["alpha"]
+        with pytest.raises(UnknownModelError):
+            reg.resolve("beta")
+        # alpha still warm: the same prompt reuses its cached prefix
+        out = reg.create_chat_completion(MSGS, max_tokens=8,
+                                         temperature=0.0, model="alpha")
+        assert out["lfkt_timings"].get("prefix_reused_tokens", 0) > 0
+    finally:
+        reg.shutdown()
+
+
+def test_reload_default_reresolves_and_changed_spec_refused(ggufs):
+    reg = _registry(ggufs)
+    try:
+        # removing the default alias re-resolves to the new manifest's
+        # first entry
+        doc = reg.reload_manifest(f"beta={ggufs['b']}")
+        assert doc["default_model"] == "beta"
+        assert reg.resolve(None).model_name == "beta"
+
+        # changing a KEPT model's spec in place is refused with
+        # attribution, set untouched
+        with pytest.raises(ValueError, match="beta"):
+            reg.reload_manifest(f"beta={ggufs['b']}:n_ctx=128")
+        assert reg.model_names() == ["beta"]
+    finally:
+        reg.shutdown()
+
+
+def test_reload_inflight_requests_finish_before_release():
+    """A removed model's in-flight request completes; the reload blocks
+    on it (bounded) and only then releases the engine."""
+    slow = FakeEngine(reply="slow-done", delay=0.6)
+    reg = ModelRegistry({"alpha": FakeEngine(reply="a"), "beta": slow},
+                        "alpha")
+    results = {}
+
+    def call():
+        results["beta"] = reg.create_chat_completion(
+            [{"role": "user", "content": "hi"}], model="beta")
+
+    th = threading.Thread(target=call)
+    th.start()
+    time.sleep(0.15)                       # the request is in flight
+    assert reg.inflight("beta") == 1
+    t0 = time.time()
+    doc = reg.reload_manifest("alpha=whatever.gguf")
+    wall = time.time() - t0
+    th.join(timeout=5)
+    # reload waited for the in-flight request (not a fixed sleep: the
+    # 0.6 s generation minus the 0.15 s head start bounds it below)
+    assert wall >= 0.3
+    assert doc["removed"][0]["inflight_at_release"] == 0
+    assert results["beta"]["choices"][0]["message"]["content"] \
+        == "slow-done"
+    with pytest.raises(UnknownModelError):
+        reg.resolve("beta")
+
+
+def test_reload_warmup_failure_unwinds_everything():
+    """A warmup (compile) failure during reload behaves exactly like a
+    budget refusal: EVERY engine this reload built is released — the one
+    that failed AND earlier successes — no loading row survives, and the
+    running set is untouched."""
+    built = {}
+
+    class _Eng:
+        def __init__(self, name, explode):
+            self.model_name = name
+            self.weight_bytes = 10
+            self._explode = explode
+            self.shutdowns = 0
+
+        def warmup(self):
+            if self._explode:
+                raise RuntimeError("compile boom")
+
+        def create_chat_completion(self, *a, **kw):
+            return {"choices": []}
+
+        def shutdown(self):
+            self.shutdowns += 1
+
+    def build(spec, path, pool):
+        e = _Eng(spec.name, explode=(spec.name == "bad"))
+        built[spec.name] = e
+        return e
+
+    reg = ModelRegistry.from_specs(parse_manifest("alpha=x.gguf"), build,
+                                   default_model="alpha")
+    with pytest.raises(RuntimeError, match="compile boom"):
+        reg.reload_manifest("alpha=x.gguf,good=y.gguf,bad=z.gguf")
+    assert reg.model_names() == ["alpha"]
+    assert {r["name"] for r in reg.models()} == {"alpha"}
+    assert built["good"].shutdowns == 1      # installed nothing, leaked
+    assert built["bad"].shutdowns == 1       # ... nothing
+
+
+def test_reload_without_build_cannot_add():
+    reg = ModelRegistry({"alpha": FakeEngine()}, "alpha")
+    with pytest.raises(ValueError, match="cannot load new ones"):
+        reg.reload_manifest("alpha=x.gguf,newbie=y.gguf")
+    # remove-only works without a builder (test above) and no-op reloads
+    # are clean
+    doc = reg.reload_manifest("alpha=x.gguf")
+    assert doc["added"] == [] and doc["removed"] == []
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface: POST /admin/models/reload + /v1/models tracking
+# ---------------------------------------------------------------------------
+
+def _client(engine, **settings_kw):
+    settings_kw.setdefault("watchdog", False)
+    app = create_app(engine=engine, settings=Settings(**settings_kw))
+    return app, httpx.ASGITransport(app=app)
+
+
+@pytest.mark.anyio
+async def test_admin_reload_route_roundtrip(ggufs):
+    reg = _registry(ggufs)
+    app, transport = _client(reg)
+    async with transport:
+        await app.router.startup()
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://t",
+                                     timeout=300.0) as c:
+            r = await c.get("/v1/models")
+            assert [m["id"] for m in r.json()["data"]] == ["alpha",
+                                                           "beta"]
+            # add gamma + drop beta in one reload
+            r = await c.post("/admin/models/reload", json={
+                "models": f"alpha={ggufs['a']},gamma={ggufs['c']}"})
+            assert r.status_code == 200, r.text
+            doc = r.json()
+            assert doc["added"] == ["gamma"]
+            assert [x["name"] for x in doc["removed"]] == ["beta"]
+            # /v1/models tracks the live set
+            r = await c.get("/v1/models")
+            assert [m["id"] for m in r.json()["data"]] == ["alpha",
+                                                           "gamma"]
+            # /health rows carry the states
+            h = await c.get("/health")
+            rows = h.json()["engine"]["models"]
+            assert {x["name"]: x["state"] for x in rows} == {
+                "alpha": "ready", "gamma": "ready"}
+            # traffic on the removed alias 400s cleanly, naming the set
+            r = await c.post("/v1/chat/completions", json={
+                "model": "beta", "max_tokens": 4,
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status_code == 400
+            body = r.json()
+            assert body["error"]["code"] == "model_not_found"
+            # the new model actually serves through the facade
+            r = await c.post("/v1/chat/completions", json={
+                "model": "gamma", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status_code == 200
+            # budget refusal -> 409, set untouched
+            reg._weight_budget_bytes = 1
+            r = await c.post("/admin/models/reload", json={
+                "models": (f"alpha={ggufs['a']},gamma={ggufs['c']},"
+                           f"beta={ggufs['b']}")})
+            assert r.status_code == 409
+            assert "budget" in r.json()["detail"]
+            r = await c.get("/v1/models")
+            assert [m["id"] for m in r.json()["data"]] == ["alpha",
+                                                           "gamma"]
+            # bad grammar -> 400
+            r = await c.post("/admin/models/reload",
+                             json={"models": "no-path-here"})
+            assert r.status_code == 400
+        await app.router.shutdown()
+    reg.shutdown()
+
+
+@pytest.mark.anyio
+async def test_admin_reload_refused_on_single_engine():
+    app, transport = _client(FakeEngine())
+    async with transport:
+        await app.router.startup()
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://t") as c:
+            r = await c.post("/admin/models/reload",
+                             json={"models": "a=x.gguf"})
+            assert r.status_code == 400
+            assert "LFKT_MODELS" in r.json()["detail"]
+        await app.router.shutdown()
+
+
+def test_reload_metrics_emitted(ggufs):
+    """model_reloads_total{action} rides the injected metrics sink."""
+    from llama_fastapi_k8s_gpu_tpu.utils.metrics import Metrics
+
+    reg = _registry(ggufs)
+    m = Metrics()
+    reg.metrics_sink = m
+    try:
+        reg.reload_manifest(
+            f"alpha={ggufs['a']},beta={ggufs['b']},gamma={ggufs['c']}")
+        reg.reload_manifest(f"alpha={ggufs['a']}")
+        reg._weight_budget_bytes = 1
+        with pytest.raises(WeightBudgetError):
+            reg.reload_manifest(f"alpha={ggufs['a']},beta={ggufs['b']}")
+        text = m.render()
+        assert 'model_reloads_total{action="add"} 1' in text
+        assert 'model_reloads_total{action="remove"} 2' in text
+        assert 'model_reloads_total{action="refused"} 1' in text
+    finally:
+        reg.shutdown()

@@ -53,6 +53,14 @@ CHECKS: list[tuple[str, list[str]]] = [
                             os.path.join(ROOT, "tests",
                                          "test_decode_loop.py"),
                             "-k", "serial_parity"]),
+    # fleet-tier byte-exactness (ISSUE 14): greedy output proxied through
+    # the prefix-affinity router must be BYTE-identical to direct-to-
+    # replica serving — the router relays raw backend bytes, and this
+    # gate keeps any future header/body rewriting honest.
+    ("fleet-route-parity", ["env", "JAX_PLATFORMS=cpu", sys.executable,
+                            "-m", "pytest", "-q", "-p", "no:cacheprovider",
+                            os.path.join(ROOT, "tests", "test_fleet.py"),
+                            "-k", "route_parity"]),
 ]
 
 
