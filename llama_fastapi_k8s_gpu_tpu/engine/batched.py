@@ -146,7 +146,7 @@ class MeshEngine(Engine):
                     self.mesh.shape["tp"], self.batch_size)
 
     # ------------------------------------------------------------------
-    def create_chat_completions(
+    def create_chat_completions(  # lfkt: blocks-under[_lock] -- the mesh engine serializes whole batches under its lock by design: drill sleeps and incident capture ride the generation path
         self,
         batch_messages: Sequence[Sequence[dict]],
         *,

@@ -638,7 +638,7 @@ class Engine:
             return "int8", None
         return "q4k", frozenset(passed)
 
-    def warmup(self):
+    def warmup(self):  # lfkt: blocks-under[_lock] -- warmup compiles and syncs under the engine lock by design: a request must never race a half-warmed cache
         """Compile every (bucket, chunk) shape so no request pays a cold
         compile — the TPU analogue of the reference's eager model load.
         With speculation enabled this drives BOTH decode paths: a
@@ -1109,7 +1109,7 @@ class Engine:
             logger.exception("disagg prefetch failed; serving local "
                              "prefill")
 
-    def prefill_to_pages(self, ids, *, namespace: str = "",
+    def prefill_to_pages(self, ids, *, namespace: str = "",  # lfkt: blocks-under[_lock] -- the serial engine's lock IS the request serialization: prefill syncs and pool spills run under it by design
                          deadline=None):
         """The prefill TIER's page service (serving/disagg/prefiller.py):
         ensure the whole-page prefix of ``ids`` is committed in the
@@ -1499,7 +1499,7 @@ class Engine:
         trace.note(deadline=deadline, tokens=0, **self._trace_attrs())
         return trace.span("engine").set(**self._trace_attrs())
 
-    def _generate(self, messages, sp, max_tokens, stops, seed,
+    def _generate(self, messages, sp, max_tokens, stops, seed,  # lfkt: blocks-under[_lock] -- the serial engine's lock IS the request serialization: the whole generation (device syncs, drill sleeps, incident capture) runs under it by design
                   deadline=None, abort=None, trace=None) -> dict:
         # disagg decode role: one bounded remote-prefill hop BEFORE the
         # generation lock (loopback mode's page service needs it); role
@@ -1557,7 +1557,7 @@ class Engine:
             },
         }
 
-    def _generate_stream(self, messages, sp, max_tokens, stops, seed,
+    def _generate_stream(self, messages, sp, max_tokens, stops, seed,  # lfkt: blocks-under[_lock] -- the serial engine's lock IS the request serialization: the whole generation (device syncs, drill sleeps, incident capture) runs under it by design
                          deadline=None, abort=None,
                          trace=None) -> Iterator[dict]:
         # same pre-lock remote-prefill hop as _generate (one attribute

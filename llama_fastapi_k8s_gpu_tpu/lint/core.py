@@ -139,6 +139,12 @@ class Context:
         return mod
 
 
+def _sha256(text: str) -> str:
+    import hashlib
+
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
 def _py_files(root: str) -> list[str]:
     out = []
     for dirpath, dirnames, filenames in os.walk(root):
@@ -156,12 +162,13 @@ def _py_files(root: str) -> list[str]:
 
 def _checkers() -> list[tuple[dict, Callable[[Context], list[Finding]]]]:
     # imported lazily so a syntax error in one checker names itself cleanly
-    from . import (configreg, deadcode, degrade, donation, jit, kernels,
-                   locks, obsreg, perf, resources)
+    from . import (concurrency, configreg, deadcode, degrade, donation,
+                   jit, kernels, locks, obsreg, perf, resources)
 
     return [(mod.RULES, mod.check)
-            for mod in (locks, jit, configreg, obsreg, kernels, perf,
-                        resources, donation, degrade, deadcode)]
+            for mod in (locks, concurrency, jit, configreg, obsreg,
+                        kernels, perf, resources, donation, degrade,
+                        deadcode)]
 
 
 def all_rules() -> dict[str, str]:
@@ -195,10 +202,17 @@ def _core_findings(ctx: Context, known: set[str]) -> list[Finding]:
 
 
 def run_lint(package_dir: str | None = None, repo_root: str | None = None,
-             rules: Iterable[str] | None = None) -> list[Finding]:
+             rules: Iterable[str] | None = None,
+             incremental: dict | None = None) -> list[Finding]:
     """Run every checker; returns ALL findings with ``suppressed`` applied
     (callers filter).  Defaults analyze this installed package and, when it
-    lives in a repo checkout, the repo's tests/tools/bench/helm/docs."""
+    lives in a repo checkout, the repo's tests/tools/bench/helm/docs.
+
+    ``incremental`` is the ``--changed`` plumbing (lint/__main__.py): a
+    mutable dict with the loaded summary ``cache`` and current content
+    ``shas``; lint/concurrency.py reuses cached per-file summaries whose
+    sha still matches and writes the refreshed cache doc back under
+    ``incremental["out"]``."""
     if package_dir is None:
         package_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo_root is None:
@@ -214,6 +228,10 @@ def run_lint(package_dir: str | None = None, repo_root: str | None = None,
             if os.path.exists(p):
                 ref_roots.append(p)
     ctx = Context(package_dir, repo_root, ref_roots)
+    if incremental is not None:
+        incremental.setdefault(
+            "shas", {src.rel: _sha256(src.text) for src in ctx.sources})
+        ctx.lint_incremental = incremental
 
     wanted = set(rules) if rules is not None else None
     known = set(all_rules())

@@ -170,7 +170,7 @@ def _load() -> ctypes.CDLL | None:
     return _bind(built)  # all caches unwritable: serve from the tmp build
 
 
-def get_lib() -> ctypes.CDLL | None:
+def get_lib() -> ctypes.CDLL | None:  # lfkt: blocks-under[_lock] -- one-time lazy native build/dlopen: concurrent callers must block until the handle exists, then every call is a cached read
     """The loaded native library, building it on first call; None if unavailable."""
     global _lib, _load_attempted
     if not _enabled():

@@ -366,25 +366,36 @@ class DevtimeRegistry:
     def snapshot(self) -> dict:
         """The full /debug/compiles document: program inventory with
         per-signature compile walls (display-bounded)."""
+        # copy-then-release (lfkt-lint LOCK006): O(programs) field copies
+        # under the lock; the sort and document assembly run OFF it so a
+        # /debug/compiles read never stalls a compile-event record
         with self._lock:
-            programs = []
-            for name in sorted(self._programs):
-                p = self._programs[name]
-                sigs = [{"signature": s, **meta}
-                        for s, meta in p.signatures.items()]
-                programs.append({
-                    "name": p.name, "kind": p.kind, "site": p.site,
-                    "compiles": p.compiles, "dispatches": p.dispatches,
-                    "compile_seconds_total": round(p.compile_s, 6),
-                    "signatures": len(p.sig_seen),
-                    "storms": p.storms,
-                    "signature_list": sigs,   # ledger bounds retention
-                })
-            return {"armed": self._armed, "budget": self.budget,
-                    "storms_total": self.storms_total,
-                    "events_dropped": self.events_dropped,
-                    "degrades": [dict(v) for v in self._degrades.values()],
-                    "programs": programs}
+            rows = [(p.name, p.kind, p.site, p.compiles, p.dispatches,
+                     p.compile_s, len(p.sig_seen), p.storms,
+                     dict(p.signatures))
+                    for p in self._programs.values()]
+            degrades = [dict(v) for v in self._degrades.values()]
+            armed = self._armed
+            storms_total = self.storms_total
+            dropped = self.events_dropped
+        programs = []
+        for name, kind, site, compiles, dispatches, compile_s, n_sigs, \
+                storms, signatures in sorted(rows):
+            sigs = [{"signature": s, **meta}
+                    for s, meta in signatures.items()]
+            programs.append({
+                "name": name, "kind": kind, "site": site,
+                "compiles": compiles, "dispatches": dispatches,
+                "compile_seconds_total": round(compile_s, 6),
+                "signatures": n_sigs,
+                "storms": storms,
+                "signature_list": sigs,   # ledger bounds retention
+            })
+        return {"armed": armed, "budget": self.budget,
+                "storms_total": storms_total,
+                "events_dropped": dropped,
+                "degrades": degrades,
+                "programs": programs}
 
 
 class _TimedJit:

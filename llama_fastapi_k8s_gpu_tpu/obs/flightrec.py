@@ -148,11 +148,17 @@ class FlightRecorder:
             armed = bool(self._dir)
             if armed:
                 # continue the on-disk sequence so a restarted process
-                # never overwrites the previous crash's evidence
+                # never overwrites the previous crash's evidence; the
+                # directory scan runs OFF the lock (lfkt-lint LOCK006:
+                # a slow volume must not stall a concurrent record()'s
+                # debounce/seq window behind disk I/O).  MERGED, never
+                # assigned: a record() that wrote seq N+1 between the
+                # scan and the lock must not be rewound to a stale N —
+                # the sequence only ever moves forward
+                names = self._list_files()
                 with self._lock:
                     self._seq = max(
-                        [self._file_seq(n) for n in self._list_files()]
-                        or [0])
+                        [self._seq] + [self._file_seq(n) for n in names])
                     self._last_at.clear()
                 # crash-leftover .tmp files are swept lazily at the FIRST
                 # write, never here: arming is also what a read-only tool

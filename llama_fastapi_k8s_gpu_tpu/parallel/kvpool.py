@@ -376,7 +376,7 @@ class KVPool:
             self.counters["misses"] += 1
         self._emit("inc", "prefix_cache_misses_total")
 
-    def acquire(self, ids, tokens: int, span=None, *,
+    def acquire(self, ids, tokens: int, span=None, *,  # lfkt: blocks-under[_lock] -- spill-tier restore/evict moves pages device<->host: the radix+refcount walk and the copy must be atomic (bounded by page-group size)
                 namespace: str = "") -> _Lease | None:
         """Pin the pages covering ``ids[:tokens]`` (``tokens`` a multiple
         of the page size, at most :meth:`match_len`).  Spilled pages on the
@@ -466,7 +466,7 @@ class KVPool:
                        host_s=round(time.time() - t0, 6))
         return ring
 
-    def export_pages(self, lease: _Lease) -> list:
+    def export_pages(self, lease: _Lease) -> list:  # lfkt: blocks-under[_lock] -- the export gather is a synchronous DMA exactly like the spill path's; the pin+copy must be atomic against eviction
         """Host copies of the lease's pages, one stacked array per cache
         leaf (leading axis = page, in lease order) — the disagg wire's
         payload unit (serving/disagg/wire.py).  The lease pins the pages,
@@ -479,7 +479,7 @@ class KVPool:
             self.counters["exported_pages"] += len(lease.page_ids)
         return leaves
 
-    def import_pages(self, ids, leaves, *, namespace: str = "",
+    def import_pages(self, ids, leaves, *, namespace: str = "",  # lfkt: blocks-under[_lock] -- wire-page upload indexes into the radix as it copies: the index+arena move must be atomic (bounded by page-group size)
                      span=None) -> int:
         """Index externally produced KV pages — the disagg decode side
         (serving/disagg/decoder.py): the whole-page prefix of ``ids``
@@ -783,7 +783,7 @@ class KVPool:
                        host_s=round(time.time() - t0, 6))
         return True
 
-    def _commit_impl(self, ids: list, ring=None, bcache=None, lane=None,
+    def _commit_impl(self, ids: list, ring=None, bcache=None, lane=None,  # lfkt: blocks-under[_lock] -- commit indexes the tail into the radix as it stores: spill-tier evictions on the alloc path are part of the atomic move
                      span=None, namespace: str = "") -> int:
         with self._lock:
             if len(ids) < self.page_tokens:

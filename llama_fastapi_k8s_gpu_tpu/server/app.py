@@ -847,11 +847,15 @@ def create_app(engine=None, settings: Settings | None = None,
     @app.on_event("shutdown")
     async def shutdown_event():
         if app.state.watchdog is not None:
-            app.state.watchdog.stop()
-            app.state.watchdog = None
+            # stop() joins the watchdog thread — a blocking wait that
+            # must not run on the event loop (lfkt-lint ASY001): the
+            # loop keeps draining in-flight responses while the join
+            # rides a worker thread
+            watchdog, app.state.watchdog = app.state.watchdog, None
+            await asyncio.to_thread(watchdog.stop)
         if app.state.disagg is not None:
-            app.state.disagg.close()
-            app.state.disagg = None
+            disagg, app.state.disagg = app.state.disagg, None
+            await asyncio.to_thread(disagg.close)
 
     def _enqueue_rd(request: Request, messages: list[dict],
                     extra: dict | None = None, *, model: str | None = None,

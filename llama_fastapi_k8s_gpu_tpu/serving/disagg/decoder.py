@@ -131,6 +131,7 @@ class DisaggClient:
             # (or a local commit) already covers it — no wire round trip
             self._count("warm_local_skips")
             return 0
+        hop_err: BaseException | None = None
         with self._hop_lock:
             # budget is computed AFTER the hop lock: hops serialize (one
             # framed connection), and time spent waiting for another
@@ -148,7 +149,7 @@ class DisaggClient:
                 self._count("warm_local_skips")
                 return 0
             t0 = time.time()
-            conn = self._ensure_conn(budget)
+            conn = self._ensure_conn(budget)  # lfkt: blocks-under[_hop_lock] -- hops serialize on one framed connection: the hop lock IS that serialization, and every wire op is budget-bounded
             if conn is None:
                 if self._refused is None:
                     self._fallback("peer_unreachable",
@@ -158,7 +159,7 @@ class DisaggClient:
                 self._rid += 1
                 rid = self._rid
                 conn.settimeout(max(0.1, budget))
-                conn.send_frame(wire.FRAME_REQ, {
+                conn.send_frame(wire.FRAME_REQ, {  # lfkt: blocks-under[_hop_lock] -- hops serialize on one framed connection: the hop lock IS that serialization, and every wire op is budget-bounded
                     "rid": rid, "namespace": namespace,
                     "ids": [int(t) for t in ids], "deadline": deadline})
                 groups: list[list] = []
@@ -169,7 +170,7 @@ class DisaggClient:
                     if remaining <= 0:
                         raise socket.timeout("disagg hop budget exhausted")
                     conn.settimeout(remaining)
-                    ftype, hdr, payload = conn.recv_frame()
+                    ftype, hdr, payload = conn.recv_frame()  # lfkt: blocks-under[_hop_lock] -- hops serialize on one framed connection: the hop lock IS that serialization, and every wire op is budget-bounded
                     if hdr.get("rid") not in (rid, None):
                         raise wire.WireError(
                             f"frame for rid {hdr.get('rid')} inside "
@@ -205,9 +206,20 @@ class DisaggClient:
                         f"{wire.FRAME_NAMES.get(ftype, ftype)} frame")
             except (wire.WireError, ConnectionError, OSError) as e:
                 # socket.timeout is an OSError: one handler for peer
-                # death, torn frames, and a wire too slow for the budget
-                self._peer_dead(e)
-                return 0
+                # death, torn frames, and a wire too slow for the budget.
+                # The connection LATCH (drop + backoff) happens here,
+                # still under the hop lock — the next hop's _ensure_conn
+                # must never race a half-torn connection — but the
+                # flight-recorder bundle and health transition run after
+                # the lock releases (below): a slow incident-volume
+                # write must never stall the NEXT request's hop behind
+                # disk I/O (lfkt-lint LOCK006, ISSUE 15;
+                # tests/test_disagg.py::test_peer_dead_bundle_off_hop_lock)
+                self._drop_conn()
+                hop_err = e
+        if hop_err is not None:
+            self._peer_dead_report(hop_err)
+            return 0
         covered = 0
         if got_pages:
             leaves = [np.concatenate([g[i] for g in groups], axis=0)
@@ -281,14 +293,23 @@ class DisaggClient:
         self._emit("inc", "disagg_handshake_refusals_total")
         self._fallback("refused", msg)
 
-    def _peer_dead(self, exc: BaseException) -> None:
-        """Transport/wire failure mid-hop: drop the connection, back off,
-        degrade with attribution + a flight-recorder bundle."""
+    def _drop_conn(self) -> None:
+        """Latch a dead connection: drop it and arm the reconnect
+        backoff.  Runs UNDER the hop lock (prefetch's except handler):
+        the swap must not race a concurrent hop's _ensure_conn — an
+        off-lock drop could close a freshly re-established healthy
+        connection out from under the next hop."""
         conn, self._conn = self._conn, None
         if conn is not None:
             conn.close()
         self._next_retry = time.time() + self._backoff
         self._backoff = min(self._backoff * 2, _BACKOFF_MAX_S)
+
+    def _peer_dead_report(self, exc: BaseException) -> None:
+        """Attribution for a transport/wire failure mid-hop: degrade
+        with a flight-recorder bundle.  Runs OFF the hop lock (lfkt-lint
+        LOCK006): the bundle is disk I/O and must not stall the next
+        request's hop."""
         msg = f"{type(exc).__name__}: {exc}"
         # the black box: by the time an operator looks, the socket state
         # is gone — bundle the ledger/traces/stats at the moment of death

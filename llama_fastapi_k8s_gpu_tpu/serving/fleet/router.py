@@ -455,4 +455,9 @@ class FleetRouter:
                 pass
         async with server:
             await stop.wait()
-        self.peers.stop()
+        # PeerTable.stop() joins the prober thread, which may be inside
+        # a probe_timeout-long socket wait — joining ON the loop would
+        # freeze every in-flight proxied stream for up to probe_timeout
+        # + probe_seconds at shutdown (lfkt-lint ASY001, ISSUE 15):
+        # the join rides a worker thread, the loop keeps relaying
+        await asyncio.to_thread(self.peers.stop)

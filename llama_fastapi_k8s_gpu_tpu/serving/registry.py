@@ -390,7 +390,7 @@ class ModelRegistry:
                 if r["name"] == name:
                     r["state"] = state
 
-    def reload_manifest(self, manifest: str, default_model: str = "", *,
+    def reload_manifest(self, manifest: str, default_model: str = "", *,  # lfkt: blocks-under[_reload_lock] -- reloads serialize whole-operation by design; the routing lock (_lock) is never held across loads, so resolve() stays hot
                         drain_seconds: float = 30.0) -> dict:
         """Diff a new ``LFKT_MODELS`` manifest against the running set and
         converge to it WITHOUT a pod restart (``POST /admin/models/reload``

@@ -59,6 +59,16 @@ class _Series:
             self.total = 0.0
             self.count = 0
 
+    def copy(self) -> "_Series":
+        """Numeric snapshot for the render path (copy-then-release)."""
+        c = _Series.__new__(_Series)
+        c.value = self.value
+        if hasattr(self, "buckets"):
+            c.buckets = list(self.buckets)
+            c.total = self.total
+            c.count = self.count
+        return c
+
     def quantile(self, metric: Metric, q: float) -> float:
         """histogram_quantile(): linear interpolation inside the bucket the
         q-th observation falls in — between that bucket's OWN bounds (the
@@ -180,48 +190,54 @@ class Metrics:
         return "{" + ",".join(parts) + "}" if parts else ""
 
     def render(self) -> str:
-        lines: list[str] = []
+        # copy-then-release (lfkt-lint LOCK006): the numeric state is
+        # snapshotted under the lock in O(series); the O(n log n) sort
+        # and all exposition string work run OFF it, so a /metrics
+        # scrape never stalls a hot-path inc() behind formatting
         with self._lock:
-            for name in sorted(self._series):
-                metric = lookup(name)
-                mtype = metric.mtype if not metric.prefix else GAUGE
-                series = self._series[name]
-                lines.append(f"# HELP {name} {metric.help}")
-                lines.append(f"# TYPE {name} {mtype}")
-                if mtype != HISTOGRAM:
-                    for key in sorted(series):
-                        lines.append(
-                            f"{name}{self._label_str(metric, key)} "
-                            f"{_fmt(series[key].value)}")
-                    continue
+            snap = {name: {key: s.copy() for key, s in by_label.items()}
+                    for name, by_label in self._series.items()}
+        lines: list[str] = []
+        for name in sorted(snap):
+            metric = lookup(name)
+            mtype = metric.mtype if not metric.prefix else GAUGE
+            series = snap[name]
+            lines.append(f"# HELP {name} {metric.help}")
+            lines.append(f"# TYPE {name} {mtype}")
+            if mtype != HISTOGRAM:
                 for key in sorted(series):
-                    s = series[key]
-                    cum = 0
-                    for bound, n in zip(metric.buckets, s.buckets):
-                        cum += n
-                        le = f'le="{_fmt(bound)}"'
-                        lines.append(
-                            f"{name}_bucket"
-                            f"{self._label_str(metric, key, le)} {cum}")
-                    inf = 'le="+Inf"'
                     lines.append(
-                        f"{name}_bucket{self._label_str(metric, key, inf)} "
-                        f"{s.count}")
+                        f"{name}{self._label_str(metric, key)} "
+                        f"{_fmt(series[key].value)}")
+                continue
+            for key in sorted(series):
+                s = series[key]
+                cum = 0
+                for bound, n in zip(metric.buckets, s.buckets):
+                    cum += n
+                    le = f'le="{_fmt(bound)}"'
                     lines.append(
-                        f"{name}_sum{self._label_str(metric, key)} "
-                        f"{_fmt(s.total)}")
+                        f"{name}_bucket"
+                        f"{self._label_str(metric, key, le)} {cum}")
+                inf = 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket{self._label_str(metric, key, inf)} "
+                    f"{s.count}")
+                lines.append(
+                    f"{name}_sum{self._label_str(metric, key)} "
+                    f"{_fmt(s.total)}")
+                lines.append(
+                    f"{name}_count{self._label_str(metric, key)} "
+                    f"{s.count}")
+            # derived quantiles: separate gauge families (legal — a
+            # histogram family itself may not carry quantile samples)
+            for suffix, q in QUANTILES:
+                lines.append(
+                    f"# HELP {name}_{suffix} derived {q:.2f} quantile "
+                    f"of {name}")
+                lines.append(f"# TYPE {name}_{suffix} gauge")
+                for key in sorted(series):
                     lines.append(
-                        f"{name}_count{self._label_str(metric, key)} "
-                        f"{s.count}")
-                # derived quantiles: separate gauge families (legal — a
-                # histogram family itself may not carry quantile samples)
-                for suffix, q in QUANTILES:
-                    lines.append(
-                        f"# HELP {name}_{suffix} derived {q:.2f} quantile "
-                        f"of {name}")
-                    lines.append(f"# TYPE {name}_{suffix} gauge")
-                    for key in sorted(series):
-                        lines.append(
-                            f"{name}_{suffix}{self._label_str(metric, key)} "
-                            f"{_fmt(series[key].quantile(metric, q))}")
+                        f"{name}_{suffix}{self._label_str(metric, key)} "
+                        f"{_fmt(series[key].quantile(metric, q))}")
         return "\n".join(lines) + "\n"

@@ -1,0 +1,43 @@
+"""ASY001/ASY002 fixtures: blocking the event loop, the PR-10 incident
+read regression pin, and the sanctioned ``asyncio.to_thread`` twins.
+
+The acceptance pin (ISSUE 15): ``incidents_on_loop`` is the PR-10
+``/debug/incidents`` bug re-created — the bundle's disk read moved back
+onto the asyncio serving loop.  The hand-fix that shipped
+(``await asyncio.to_thread(...)``) is ``incidents_hopped`` and must stay
+silent, as must awaiting it.
+"""
+
+import asyncio
+import json
+import time
+
+
+def _read_bundle(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+async def incidents_on_loop():
+    return _read_bundle("/tmp/x.json")      # ASY001: PR-10 regression — incident read on the event loop
+
+
+async def sleep_on_loop():
+    time.sleep(0.1)                         # ASY001: direct sleep on the loop
+
+
+async def awaits_blocker():
+    return await incidents_on_loop()        # ASY002: awaited coroutine transitively blocks
+
+
+async def incidents_hopped():
+    return await asyncio.to_thread(_read_bundle, "/tmp/x.json")  # fine: the to_thread hop
+
+
+async def hopped_caller():
+    return await incidents_hopped()         # fine: the awaited coroutine never blocks the loop
+
+
+#: referenced so DEAD001 stays scoped to its own fixture
+HANDLERS = (incidents_on_loop, sleep_on_loop, awaits_blocker,
+            incidents_hopped, hopped_caller)

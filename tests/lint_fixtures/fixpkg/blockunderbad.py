@@ -1,0 +1,72 @@
+"""LOCK006 fixtures: blocking work under a held lock, the PR-10
+fragmentation-scan regression pin, the sanctioned copy-then-release and
+``blocks-under`` twins, and the annotation-grammar violations.
+
+The acceptance pin (ISSUE 15): ``occupancy_inlined`` is the PR-10 KVPool
+bug re-created — the O(n log n) free-run scan back INSIDE the pool lock.
+The hand-fix that shipped (copy the snapshot under the lock, scan
+outside) is ``occupancy_fixed`` and must stay silent.
+"""
+
+import threading
+import time
+
+
+class BlockUnder:
+    _GUARDED_BY = {"_free": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = []
+
+    def sleep_under(self):
+        with self._lock:
+            time.sleep(0.1)         # LOCK006: direct sleep under the lock
+
+    def chain_under(self):
+        with self._lock:
+            self._disk_read()       # LOCK006: blocks via a helper chain
+
+    def _disk_read(self):
+        with open("/dev/null") as f:
+            return f.read()
+
+    def occupancy_inlined(self):
+        with self._lock:
+            run = best = 0
+            prev = None
+            for pid in sorted(self._free):  # LOCK006: PR-10 regression — fragmentation scan under the pool lock
+                run = run + 1 if prev is not None and pid == prev + 1 else 1
+                best = max(best, run)
+                prev = pid
+            return best
+
+    def scan_via_helper(self):
+        with self._lock:
+            return self._scan()             # LOCK006: the PR-10 scan factored one level down must still fire
+
+    def _scan(self):
+        return sorted(self._free)
+
+    def occupancy_fixed(self):
+        with self._lock:
+            free_ids = list(self._free)
+        run = best = 0
+        prev = None
+        for pid in sorted(free_ids):        # fine: scan off the lock (copy-then-release)
+            run = run + 1 if prev is not None and pid == prev + 1 else 1
+            best = max(best, run)
+            prev = pid
+        return best
+
+    def audited_hold(self):  # lfkt: blocks-under[_lock] -- fixture: deliberate hold-and-block with a written reason (the audited twin)
+        with self._lock:
+            time.sleep(0.1)                 # fine: discharged by the def-line audit
+
+    def reasonless_audit(self):
+        with self._lock:
+            time.sleep(0.1)  # lfkt: blocks-under[_lock]
+
+    def unknown_lock_audit(self):
+        with self._lock:
+            time.sleep(0.2)  # lfkt: blocks-under[_phantom] -- no such lock exists anywhere

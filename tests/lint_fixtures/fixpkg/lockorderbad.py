@@ -1,0 +1,74 @@
+"""LOCK005 fixtures: lock-order cycles, a transitive re-acquire, and the
+consistent-order clean twin.
+
+``OrderBad`` inverts its own two locks across two methods (the in-class
+cycle).  ``CrossA``/``CrossB`` build the interprocedural shape: A holds
+its lock and calls into B, which holds ITS lock and calls back into A —
+the two witness paths the report must carry.  The call back into
+``touch_a`` also makes ``hold_and_cross`` transitively re-acquire
+``_al`` while holding it: the one-lock cycle (a real self-deadlock on a
+non-reentrant Lock).  ``OrderClean`` takes the same two locks in the
+same order everywhere and must stay silent.
+"""
+
+import threading
+
+
+class OrderBad:
+    _GUARDED_BY = {"_x": "_la"}
+
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+        self._x = 0
+
+    def ab(self):
+        with self._la:
+            with self._lb:          # LOCK005: la -> lb leg
+                self._x = 1
+
+    def ba(self):
+        with self._lb:
+            with self._la:          # LOCK005: lb -> la leg (the cycle)
+                self._x = 2
+
+
+class CrossB:
+    def __init__(self):
+        self._bl = threading.Lock()
+
+    def grab_then_call(self, a):
+        with self._bl:
+            a.touch_a()             # LOCK005: bl -> al (by-name edge)
+
+
+class CrossA:
+    def __init__(self):
+        self._al = threading.Lock()
+        self._peer = CrossB()
+
+    def hold_and_cross(self):
+        with self._al:
+            self._peer.grab_then_call(self)   # LOCK005: al -> bl (+ al -> al)
+
+    def touch_a(self):
+        with self._al:
+            pass
+
+
+class OrderClean:
+    """Clean twin: both paths take _la before _lb — one global order."""
+
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def one(self):
+        with self._la:
+            with self._lb:          # fine: consistent order
+                pass
+
+    def two(self):
+        with self._la:
+            with self._lb:          # fine: consistent order
+                pass

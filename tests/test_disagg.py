@@ -239,6 +239,41 @@ def test_peer_dead_midstream_falls_back_degrades_and_recovers(
         rec.configure(directory="")
 
 
+def test_peer_dead_bundle_off_hop_lock(gguf_path, monkeypatch):
+    """ISSUE 15 regression (lfkt-lint LOCK006): the ``disagg_peer_dead``
+    flight-recorder bundle is disk I/O and must be captured OFF the hop
+    lock — a slow incident volume must never stall the NEXT request's
+    hop behind the bundle write.  Re-inlining the ``_peer_dead`` call
+    into ``prefetch``'s under-lock except handler makes the probe see a
+    held hop lock and fails this test (and fires LOCK006)."""
+    from llama_fastapi_k8s_gpu_tpu.obs import flightrec as fr_mod
+
+    eng_p, eng_d, srv, cli = _pair(gguf_path)
+    seen: dict = {}
+
+    def probe(kind, reason, extra=None):
+        free = cli._hop_lock.acquire(blocking=False)
+        if free:
+            cli._hop_lock.release()
+        seen["hop_lock_free"] = free
+        seen["kind"] = kind
+        return None
+
+    monkeypatch.setattr(fr_mod, "record_incident", probe)
+    try:
+        FAULTS.arm("peer_dead:error:times=1")
+        out = _greedy(eng_d)
+        # the request still answered (local fallback) ...
+        assert isinstance(out["choices"][0]["message"]["content"], str)
+        # ... the bundle was captured ...
+        assert seen.get("kind") == "disagg_peer_dead"
+        # ... and captured with the hop lock RELEASED
+        assert seen.get("hop_lock_free") is True
+    finally:
+        cli.close()
+        srv.stop()
+
+
 def test_truncated_frame_rejected_nothing_imported(gguf_path):
     """A torn PAGE frame must degrade to local prefill AND leave no
     partial prefix in the decode pool's radix (plausible-looking partial

@@ -263,6 +263,47 @@ class _FlagReplica:
         self.httpd.shutdown()
 
 
+def test_router_shutdown_joins_prober_off_loop():
+    """ISSUE 15 regression (lfkt-lint ASY001): FleetRouter.serve joins
+    the prober thread at shutdown.  The join must ride a worker thread
+    (``asyncio.to_thread``) so the event loop keeps scheduling — a
+    prober wedged in a probe_timeout-long socket wait must not freeze
+    in-flight proxied streams.  Re-inlining ``self.peers.stop()`` makes
+    the measured loop stall jump to the full wedge duration and fails
+    this test (and fires ASY001)."""
+    table = _table([_free_port()])
+    real_stop = table.stop
+
+    def wedged_stop():
+        # a prober mid-probe against a dead peer: stop() blocks in join
+        time.sleep(0.5)
+        real_stop()
+
+    table.stop = wedged_stop
+    router = FleetRouter(table, policy="affinity")
+    port = _free_port()
+
+    async def main() -> float:
+        ready, stop = asyncio.Event(), asyncio.Event()
+        task = asyncio.create_task(
+            router.serve("127.0.0.1", port, ready_event=ready,
+                         stop_event=stop))
+        await ready.wait()
+        stop.set()
+        # serve() proceeds into the peers.stop() join; with the worker
+        # hop the loop stays live and this sleep completes on time
+        t0 = time.monotonic()
+        await asyncio.sleep(0.05)
+        stall = time.monotonic() - t0
+        await task
+        return stall
+
+    stall = asyncio.run(main())
+    assert stall < 0.3, (
+        f"event loop stalled {stall:.3f}s during shutdown — the prober "
+        "join is running ON the loop")
+
+
 def test_peer_table_eject_backoff_readmit():
     rep = _FlagReplica()
     table = _table([rep.port])

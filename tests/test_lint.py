@@ -118,9 +118,18 @@ def _fired(rule, path_part, suppressed=False):
     ("EXC001", "excbad.py", 2),     # swallowing handler + ghost annotation
     ("DEAD001", "deadbad.py", 1),   # totally_unused
     ("DEAD002", "deadbad.py", 1),   # phantom __all__ export
+    ("LOCK005", "lockorderbad.py", 3),  # in-class cycle + re-acquire +
+                                        # interprocedural 2-cycle
+    ("LOCK006", "blockunderbad.py", 5),  # direct sleep + helper chain +
+                                         # PR-10 scan (inline AND via a
+                                         # helper) + unknown-lock site
+    ("ASY001", "asyncbad.py", 2),   # PR-10 incident read + direct sleep
+    ("ASY002", "asyncbad.py", 1),   # awaited coroutine blocks
     ("LINT000", "noqabad.py", 1),   # noqa without reason
     ("LINT000", "resbad.py", 1),    # transfers[] without reason
+    ("LINT000", "blockunderbad.py", 1),  # blocks-under[] without reason
     ("LINT001", "noqabad.py", 2),   # unknown rule id + empty rule list
+    ("LINT001", "blockunderbad.py", 1),  # blocks-under unknown lock
 ])
 def test_rule_fires_on_fixture(rule, path_part, min_hits):
     hits = _fired(rule, path_part)
@@ -230,6 +239,152 @@ def test_good_lock_paths_not_flagged():
         line = next(i for i, ln in enumerate(src.splitlines(), 1)
                     if marker in ln)
         assert line not in lock1, f"false positive on line {line} ({marker})"
+
+
+def test_pr10_regression_fixtures_fire():
+    """ISSUE 15 acceptance: the two PR-10 hand-fixed bugs, re-created as
+    fixture twins, are machine-caught — re-inlining the KVPool
+    fragmentation scan under the pool lock fires LOCK006; moving the
+    incident read back onto the event loop fires ASY001."""
+    scan = _fixture_line("blockunderbad.py",
+                         "PR-10 regression — fragmentation scan")
+    assert scan in {f.line for f in _fired("LOCK006", "blockunderbad.py")}
+    read = _fixture_line("asyncbad.py", "PR-10 regression — incident read")
+    assert read in {f.line for f in _fired("ASY001", "asyncbad.py")}
+
+
+def test_lock005_reports_both_witness_paths():
+    """A cross-class cycle report must carry a witness call path for
+    EVERY leg — an operator reads the two paths, picks the global order,
+    and fixes one of them (docs/LINT.md 'Reading a lock-order cycle
+    report')."""
+    cyc = [f for f in _fired("LOCK005", "lockorderbad.py")
+           if "CrossB" in f.message and "cycle over 2 locks" in f.message]
+    assert cyc, [f.render() for f in _fired("LOCK005", "lockorderbad.py")]
+    msg = cyc[0].message
+    assert "hold_and_cross" in msg and "grab_then_call" in msg
+    assert msg.count("->") >= 2     # one held->acquired arrow per leg
+
+
+def test_concurrency_clean_twins_silent():
+    """The sanctioned idioms — copy-then-release scan, the def-line
+    blocks-under audit, the asyncio.to_thread hop (and awaiting through
+    it), consistent lock order — must produce no LOCK005/006/ASY
+    findings."""
+    conc = [f for f in _fix_findings()
+            if f.rule in ("LOCK005", "LOCK006", "ASY001", "ASY002")]
+    for fname, marker in (
+            ("blockunderbad.py", "fine: scan off the lock"),
+            ("blockunderbad.py", "fine: discharged by the def-line audit"),
+            ("asyncbad.py", "fine: the to_thread hop"),
+            ("asyncbad.py", "fine: the awaited coroutine never blocks"),
+            ("lockorderbad.py", "fine: consistent order"),
+    ):
+        ln = _fixture_line(fname, marker)
+        near = [f for f in conc if fname in f.path
+                and abs(f.line - ln) <= 1]
+        assert not near, (marker, [f.render() for f in near])
+
+
+def test_changed_mode_equals_full_run(tmp_path):
+    """Satellite (ISSUE 15): ``--changed`` must produce the IDENTICAL
+    finding set to a full run — on a cold cache, on a warm no-op cache
+    (everything reused), and after a single-file edit (only that file
+    re-derived, cross-file findings still correct)."""
+    import json
+    import shutil
+
+    work = tmp_path / "lint_fixtures"
+    shutil.copytree(FIXTURES, work)
+    args = ["--json", "--package", str(work / "fixpkg"),
+            "--root", str(work)]
+
+    def run(*extra):
+        proc = subprocess.run(
+            [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.lint",
+             *extra, *args], cwd=REPO, capture_output=True, text=True,
+            timeout=300)
+        rows = sorted((d["rule"], d["path"], d["line"], d["message"])
+                      for d in map(json.loads, proc.stdout.splitlines()))
+        return rows, proc.stderr
+
+    full, _ = run()
+    cold, _ = run("--changed")                   # no cache yet
+    assert cold == full
+    cache = work / ".lfkt_lint_cache.json"
+    assert cache.exists()
+    warm, err = run("--changed")                 # everything reusable
+    assert warm == full
+    n = int(err.rsplit("reused cached summaries for", 1)[1].split()[0])
+    assert n > 0, err
+
+    # edit ONE file's body (symbols unchanged, so the resolution digest
+    # holds and every other file's summaries come from the cache), then
+    # --changed must match a fresh full run including the NEW finding
+    p = work / "fixpkg" / "blockunderbad.py"
+    src = p.read_text()
+    assert "time.sleep(0.1)         # LOCK006: direct sleep" in src
+    p.write_text(src.replace(
+        "            time.sleep(0.1)         # LOCK006: direct sleep",
+        "            time.sleep(0.1)\n"
+        "            time.sleep(0.1)         # LOCK006: direct sleep"))
+    full2, _ = run()
+    inc2, err2 = run("--changed")
+    assert inc2 == full2
+    assert inc2 != full                          # the edit IS visible
+    n2 = int(err2.rsplit("reused cached summaries for", 1)[1].split()[0])
+    assert n2 > 0, err2
+
+
+def test_resolution_digest_covers_module_instance_bindings():
+    """Rebinding a module-level instance (`FAULTS = FaultInjector()` ->
+    some other class) changes how UNCHANGED files' calls resolve, so it
+    must invalidate the --changed summary cache: module_types is part of
+    the resolution digest."""
+    from llama_fastapi_k8s_gpu_tpu.lint.callgraph import build_graph
+    from llama_fastapi_k8s_gpu_tpu.lint.concurrency import resolution_digest
+    from llama_fastapi_k8s_gpu_tpu.lint.core import Context
+
+    ctx = Context(os.path.join(FIXTURES, "fixpkg"), FIXTURES)
+    graph = build_graph(ctx)
+    before = resolution_digest(graph)
+    graph.module_types.setdefault("blockunderbad", {})["PHANTOM"] = (
+        "blockunderbad", "BlockUnder")
+    assert resolution_digest(graph) != before
+
+
+def test_lint_runtime_budget():
+    """Satellite (ISSUE 15): the full-package lint pass — the
+    interprocedural concurrency families included — must finish under a
+    fixed wall bound on CPU, so whole-package analysis can never quietly
+    make the tier-1 suite unusable.  The bound is ~10x the current cost;
+    tighten it if the suite ever gets a faster floor."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    run_lint(package_dir=os.path.join(REPO, "llama_fastapi_k8s_gpu_tpu"),
+             repo_root=REPO)
+    wall = _time.monotonic() - t0
+    assert wall < 60.0, f"full lint pass took {wall:.1f}s (budget 60s)"
+
+
+def test_concurrency_baseline_ratchet_is_empty_and_green():
+    """The committed concurrency baseline is EMPTY (every surviving
+    in-tree audit is reason-annotated instead of grandfathered), and the
+    ci_gate lint-concurrency check passes against it — i.e. the ratchet
+    currently enforces 'no unaudited concurrency finding lands at all'."""
+    import json
+
+    doc = json.load(open(os.path.join(REPO,
+                                      "lint_baseline_concurrency.json")))
+    assert doc["schema"] == 1 and doc["findings"] == []
+    proc = subprocess.run(
+        [sys.executable, "tools/lint_report.py",
+         "--baseline", "lint_baseline_concurrency.json",
+         "--rules", "LOCK005", "LOCK006", "ASY001", "ASY002"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ratchet OK" in proc.stdout
 
 
 # ---------------------------------------------------------------------------
@@ -377,9 +532,9 @@ def test_ci_gate_aggregates_lint_and_manifest():
     doc = json.loads(proc.stdout)
     assert doc["ok"] is True
     names = {c["name"] for c in doc["checks"]}
-    assert names == {"lfkt-lint", "check-manifest", "incident-schema",
-                     "disagg-wire-schema", "decode-loop-parity",
-                     "fleet-route-parity"}
+    assert names == {"lfkt-lint", "lint-concurrency", "check-manifest",
+                     "incident-schema", "disagg-wire-schema",
+                     "decode-loop-parity", "fleet-route-parity"}
     assert all(c["exit"] == 0 for c in doc["checks"])
 
 

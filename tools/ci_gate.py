@@ -28,6 +28,19 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: (name, argv) — every gate a commit must pass, in order
 CHECKS: list[tuple[str, list[str]]] = [
     ("lfkt-lint", [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.lint"]),
+    # the interprocedural concurrency families (ISSUE 15) ride a baseline
+    # ratchet: any LOCK005/LOCK006/ASY001/ASY002 finding NOT grandfathered
+    # in lint_baseline_concurrency.json fails here, and grandfathered ones
+    # may only shrink (tools/lint_report.py reports the shrink so the
+    # baseline gets trimmed).  Today the baseline is EMPTY — every
+    # surviving in-tree audit is reason-annotated instead — so this gate
+    # means "no new unaudited deadlock/stall hazard lands, ever"
+    ("lint-concurrency", [sys.executable,
+                          os.path.join(ROOT, "tools", "lint_report.py"),
+                          "--baseline",
+                          os.path.join(ROOT, "lint_baseline_concurrency.json"),
+                          "--rules", "LOCK005", "LOCK006",
+                          "ASY001", "ASY002"]),
     ("check-manifest", [sys.executable,
                         os.path.join(ROOT, "tools", "check_manifest.py")]),
     # any incident bundle present (in $LFKT_INCIDENT_DIR) must validate

@@ -99,6 +99,10 @@ def main() -> int:
     ap.add_argument("--all", action="store_true",
                     help="also list suppressed findings")
     ap.add_argument("--rule", default=None)
+    ap.add_argument("--rules", nargs="*", default=None,
+                    help="restrict to a rule FAMILY (several IDs) — the "
+                         "ci_gate lint-concurrency check ratchets "
+                         "LOCK005/LOCK006/ASY001/ASY002 through this")
     ap.add_argument("--package", default=None,
                     help="analyze a different package tree")
     ap.add_argument("--root", default=None,
@@ -112,8 +116,10 @@ def main() -> int:
     args = ap.parse_args()
 
     rules = all_rules()
+    wanted = list(args.rules) if args.rules else (
+        [args.rule] if args.rule else None)
     findings = run_lint(package_dir=args.package, repo_root=args.root,
-                        rules=[args.rule] if args.rule else None)
+                        rules=wanted)
 
     if args.write_baseline:
         return write_baseline(args.write_baseline, findings)
@@ -128,7 +134,7 @@ def main() -> int:
     print(f"{'rule':<{width}}  live  supp  description")
     print("-" * (width + 60))
     for rule in sorted(by_rule):
-        if args.rule and rule != args.rule:
+        if wanted and rule not in wanted:
             continue
         fs = by_rule[rule]
         live = sum(1 for f in fs if not f.suppressed)
