@@ -178,6 +178,27 @@ METRICS: dict[str, Metric] = _register(
            "router: one proxied request's wall (client head in -> "
            "backend response relayed)",
            buckets=LATENCY_BUCKETS),
+    # -- fleet KV migration (serving/fleet/migrate.py) ---------------------
+    Metric("kv_migration_pulls_total", COUNTER,
+           "migration pulls attempted, by trigger (remap = router "
+           "prior-owner hint, warmup = scale-out pre-pull, drain = "
+           "commanded pull from a DRAINING peer)",
+           labels=("reason",)),
+    Metric("kv_migration_pushes_total", COUNTER,
+           "migration page service: pull requests answered with pages "
+           "(this pod was the warm side)"),
+    Metric("kv_migration_pages_total", COUNTER,
+           "KV pages moved by migration, by direction (pulled | pushed)",
+           labels=("reason",)),
+    Metric("kv_migration_failures_total", COUNTER,
+           "migration attempts degraded, by reason (connect, wire, "
+           "refused, deadline, import, drain_push, ...) — every one "
+           "fell back to local recompute or plain termination, with "
+           "this attribution",
+           labels=("reason",)),
+    Metric("kv_migration_seconds", HISTOGRAM,
+           "one migration hop's wall (request -> pages imported)",
+           buckets=LATENCY_BUCKETS),
     # -- live manifest reload (serving/registry.py reload_manifest) --------
     Metric("model_reloads_total", COUNTER,
            "live-reload actions on the model registry (add = model "

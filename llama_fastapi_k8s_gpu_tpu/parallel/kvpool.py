@@ -702,6 +702,38 @@ class KVPool:
         with self._lock:
             return dict(self.counters)
 
+    def hot_prefixes(self, k: int = 8) -> list[dict]:
+        """The ``k`` most recently used cached prefixes across every
+        namespace, hottest first — the unit of fleet KV migration
+        (serving/fleet/migrate.py): graceful drain pushes these to the
+        rendezvous successors, scale-out warm-up pulls peers' lists.
+        Each row is ``{"namespace", "ids", "tokens"}`` with ``ids`` the
+        full root→leaf token run (whole pages only — exactly what
+        ``match_len``/``acquire`` on the far side can use).  Leaf chains
+        only: an interior node's run is a prefix of its children's, so
+        shipping leaves ships the interiors for free (``import_pages``
+        dedups).  Hotness = the leaf's LRU stamp; spilled leaves count
+        (their content is intact and exportable after restore)."""
+        rows: list[tuple[int, str, list[int]]] = []
+        with self._lock:
+            for node in self._nodes():
+                if node.children:
+                    continue
+                ids: list[int] = []
+                chain: list[_Node] = []
+                n: _Node | None = node
+                while n is not None and n.parent is not None:
+                    chain.append(n)
+                    n = n.parent
+                for n in reversed(chain):
+                    for page in n.edge:
+                        ids.extend(int(t) for t in page)
+                if ids:
+                    rows.append((node.stamp, node.ns, ids))
+        rows.sort(key=lambda r: r[0], reverse=True)
+        return [{"namespace": ns, "ids": ids, "tokens": len(ids)}
+                for _, ns, ids in rows[:max(0, int(k))]]
+
     # ------------------------------------------------------------------
     # internals (lock held)
     # ------------------------------------------------------------------

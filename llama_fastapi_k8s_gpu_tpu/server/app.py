@@ -48,6 +48,7 @@ from ..obs.devtime import DEVTIME
 from ..obs.logctx import access_logger, bind_request_id
 from ..obs.slo import SLOEngine
 from ..obs.trace import TRACER, Tracer
+from ..serving.fleet.affinity import AFFINITY_KEY_HEADER, PRIOR_OWNER_HEADER
 from ..utils.config import Settings, get_settings
 from ..utils.faults import FAULTS
 from ..utils.health import (
@@ -179,6 +180,9 @@ def create_app(engine=None, settings: Settings | None = None,
     #: disaggregated prefill/decode roles (serving/disagg/): armed at
     #: startup from LFKT_DISAGG_ROLE; None = the single-process path
     app.state.disagg = None
+    #: fleet KV migration (serving/fleet/migrate.py): armed at startup
+    #: from LFKT_MIGRATE; None = warm pages die with this pod
+    app.state.migration = None
     #: live manifest reload (serving/registry.py reload_manifest): one
     #: reload at a time — POST /admin/models/reload and SIGHUP share it
     app.state.reload_busy = asyncio.Lock()
@@ -801,6 +805,19 @@ def create_app(engine=None, settings: Settings | None = None,
             app.state.disagg = build_roles(
                 settings.disagg_role, engine, settings,
                 metrics=app.state.metrics, health=app.state.health)
+        # fleet KV migration (serving/fleet/migrate.py): page service +
+        # pull client, then scale-out warm-up BEFORE the READY flip so a
+        # freshly scaled replica's first routed turn lands on a warm
+        # radix tree.  Warm-up is bounded by the drain budget and every
+        # failed pull inside it degrades with attribution — a cold or
+        # absent fleet delays readiness by at most the budget.
+        if settings.migrate:
+            from ..serving.fleet.migrate import build_migration
+
+            app.state.migration = await asyncio.to_thread(
+                build_migration, engine, settings,
+                metrics=app.state.metrics, health=app.state.health)
+            await asyncio.to_thread(app.state.migration.warm_up)
         app.state.ready = True
         app.state.health.transition(READY, "engine loaded")
         if settings.watchdog and getattr(engine, "heartbeat", None) is None \
@@ -856,6 +873,9 @@ def create_app(engine=None, settings: Settings | None = None,
         if app.state.disagg is not None:
             disagg, app.state.disagg = app.state.disagg, None
             await asyncio.to_thread(disagg.close)
+        if app.state.migration is not None:
+            migration, app.state.migration = app.state.migration, None
+            await asyncio.to_thread(migration.close)
 
     def _enqueue_rd(request: Request, messages: list[dict],
                     extra: dict | None = None, *, model: str | None = None,
@@ -904,8 +924,47 @@ def create_app(engine=None, settings: Settings | None = None,
         m.set_gauge("queue_depth", queue.qsize())
         return rd
 
-    def _admit(request_body: BotMessageRequest, request: Request,
-               extra: dict | None = None) -> dict:
+    async def _migrate_hook(request: Request, messages: list[dict],
+                            raw: bool = False) -> None:
+        """Pull-on-remap (serving/fleet/migrate.py): when the fleet
+        router stamped this request, record the conversation's affinity
+        key (graceful drain's candidate set) and — if a prior owner is
+        named — pull its radix pages over the disagg wire BEFORE the
+        prefill that would otherwise recompute them.  Never raises and
+        never blocks past the migration hop budget: a failed pull is an
+        attributed degrade to a colder (but correct) local prefill."""
+        mgr = request.app.state.migration
+        if mgr is None:
+            return
+        headers = request.headers
+        key = headers.get(AFFINITY_KEY_HEADER, "")
+        prior = headers.get(PRIOR_OWNER_HEADER, "")
+        if not key and not prior:
+            return
+        engine = request.app.state.engine
+        tokenize = getattr(engine, "tokenize_messages", None)
+        if tokenize is None:
+            return
+        try:
+            # mirror the prompt the engine will actually see: the
+            # reference truncation mutates in place, so feed it copies
+            msgs = messages if raw else truncate_messages_to_fit_context(
+                [dict(m) for m in messages], settings.max_context_tokens)
+            ids = await asyncio.to_thread(tokenize, msgs)
+        except Exception:  # noqa: BLE001 — a tokenizer quirk must not
+            # fail admission; the request just prefills cold
+            return
+        ns = str(getattr(engine, "_kv_ns", "") or "")
+        if key:
+            mgr.record_prompt(key, ns, ids)
+        if prior:
+            await asyncio.to_thread(
+                mgr.pull_for_request, prior, ns, ids,
+                time.time() + settings.timeout_seconds,
+                request.scope.get("lfkt.trace"))
+
+    async def _admit(request_body: BotMessageRequest, request: Request,
+                     extra: dict | None = None) -> dict:
         """Shared admission for both response endpoints: assemble messages
         (system prompt inserted at index 1 — quirk preserved from reference
         api.py:147), validate the optional model alias (400 in the existing
@@ -917,12 +976,13 @@ def create_app(engine=None, settings: Settings | None = None,
         ]
         system_prompt = build_system_prompt(request_body.bot_profile)
         messages.insert(1, {"role": "system", "content": system_prompt})
+        await _migrate_hook(request, messages)
         return _enqueue_rd(request, messages, extra, model=model)
 
     @app.post("/response")
     async def generate_response(request_body: BotMessageRequest, request: Request):
         m = request.app.state.metrics
-        rd = _admit(request_body, request)
+        rd = await _admit(request_body, request)
         future = rd["future"]
         try:
             response = await asyncio.wait_for(future, timeout=settings.timeout_seconds)
@@ -950,8 +1010,8 @@ def create_app(engine=None, settings: Settings | None = None,
         AND a total wall-clock deadline (stream_deadline_seconds) so a
         slow-dripping generation cannot hold its queue slot forever."""
         m = request.app.state.metrics
-        rd = _admit(request_body, request,
-                    extra={"stream_queue": asyncio.Queue()})
+        rd = await _admit(request_body, request,
+                          extra={"stream_queue": asyncio.Queue()})
         loop = asyncio.get_running_loop()
         deadline = loop.time() + settings.stream_deadline_seconds
         trace = rd.get("trace")
@@ -1108,6 +1168,65 @@ def create_app(engine=None, settings: Settings | None = None,
             except ValueError as e:
                 raise HTTPException(status_code=400, detail=str(e))
 
+    @app.get("/admin/migrate/hot")
+    async def admin_migrate_hot(request: Request):
+        """This pod's hottest cached prefixes (``KVPool.hot_prefixes``)
+        — what a scale-out peer pre-pulls during warm-up
+        (serving/fleet/migrate.py).  ``?k=N`` bounds the list (default
+        LFKT_MIGRATE_TOP_K).  404-shaped refusal when migration is off:
+        a mixed-rollout fleet must get attribution, not a hang."""
+        mgr = app.state.migration
+        if mgr is None:
+            raise HTTPException(
+                status_code=404,
+                detail="KV migration is off on this pod (LFKT_MIGRATE=1 "
+                       "arms it — docs/RUNBOOK.md 'Surviving pod churn')")
+        from urllib.parse import parse_qs
+
+        q = parse_qs(request.url.query)
+        try:
+            k = int(q.get("k", [mgr.top_k])[0])
+        except ValueError:
+            raise HTTPException(status_code=400, detail="k must be an int")
+        pool = getattr(app.state.engine, "_kvpool", None)
+        rows = (await asyncio.to_thread(pool.hot_prefixes, k)
+                if pool is not None else [])
+        return {"prefixes": rows}
+
+    @app.post("/admin/migrate/pull")
+    async def admin_migrate_pull(request: Request):
+        """Commanded pull — the receiving half of a peer's graceful
+        drain (serving/fleet/migrate.py ``drain_push``): the DRAINING
+        pod names itself (``peer`` = its page-service wire addr) and the
+        conversation (``namespace`` + ``ids``); this pod pulls the pages
+        over the wire while the peer still lives.  Deadline-bounded and
+        never a hang; a failed pull answers ``covered: 0`` with the
+        degrade attributed in this pod's counters."""
+        mgr = app.state.migration
+        if mgr is None:
+            raise HTTPException(
+                status_code=404,
+                detail="KV migration is off on this pod (LFKT_MIGRATE=1 "
+                       "arms it — docs/RUNBOOK.md 'Surviving pod churn')")
+        try:
+            body = await request.json()
+        except ValueError:
+            raise HTTPException(status_code=400, detail="body must be JSON")
+        body = body if isinstance(body, dict) else {}
+        peer = str(body.get("peer") or "")
+        ids = body.get("ids")
+        if ":" not in peer or not isinstance(ids, list) or not ids:
+            raise HTTPException(
+                status_code=400,
+                detail="body needs peer (host:port of the drain side's "
+                       "page service) and ids (non-empty token list)")
+        deadline = body.get("deadline")
+        covered = await asyncio.to_thread(
+            mgr.pull, peer, [int(t) for t in ids],
+            namespace=str(body.get("namespace") or ""), reason="drain",
+            deadline=float(deadline) if deadline is not None else None)
+        return {"covered": covered}
+
     def _v1_params(body: ChatCompletionRequest) -> dict:
         """The request's explicitly-set sampling fields (unset ones fall
         back to the pod's serving defaults in _gen_kwargs)."""
@@ -1208,6 +1327,7 @@ def create_app(engine=None, settings: Settings | None = None,
             params = _v1_params(body)
             messages = [{"role": msg.role, "content": msg.content}
                         for msg in body.messages]
+            await _migrate_hook(request, messages, raw=True)
             if body.stream:
                 rd = _enqueue_rd(request, messages,
                                  {"stream_queue": asyncio.Queue()},
@@ -1362,6 +1482,13 @@ def create_app(engine=None, settings: Settings | None = None,
         # pre-disagg document
         if st.disagg is not None:
             doc["disagg"] = st.disagg.status()
+        # fleet KV migration block (serving/fleet/migrate.py): the page
+        # service's wire addr (peers resolve it through THIS document —
+        # ephemeral ports are discovery, not config), every pull/push
+        # counter, and the last attributed degrade; absent with
+        # LFKT_MIGRATE off, keeping /health byte-identical
+        if st.migration is not None:
+            doc["migration"] = st.migration.status()
         return doc
 
     @app.get("/metrics")

@@ -374,6 +374,28 @@ async def serve(app, host: str = "0.0.0.0", port: int = 8000,
         _close_conns(state, only_idle=False)
         for t in list(state["tasks"]):
             t.cancel()
+        # graceful drain of the radix cache (serving/fleet/migrate.py):
+        # AFTER in-flight requests finished (their pages are committed
+        # and included) but BEFORE app shutdown tears the page service
+        # down, hand the hottest conversations to their rendezvous
+        # successors.  drain_push bounds itself to the drain budget; the
+        # wait_for is the belt-and-braces guarantee that a wedged push
+        # can never delay termination past budget + 1s (helm's
+        # terminationGracePeriodSeconds accounts for both drains).
+        migration = getattr(getattr(app, "state", None), "migration", None)
+        if migration is not None:
+            try:
+                pushed = await asyncio.wait_for(
+                    asyncio.to_thread(migration.drain_push),
+                    migration.drain_budget + 1.0)
+                logger.info("httpd drain: migrated %d conversation(s) to "
+                            "successor peers", pushed)
+            except asyncio.TimeoutError:
+                logger.warning("httpd drain: KV page push overran its "
+                               "budget; terminating without handoff")
+            except Exception as e:  # noqa: BLE001 — a failed handoff
+                # degrades to normal termination, never blocks shutdown
+                logger.warning("httpd drain: KV page push failed: %s", e)
     await app.router.shutdown()
 
 

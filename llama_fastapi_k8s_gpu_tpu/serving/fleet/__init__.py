@@ -53,7 +53,9 @@ def build_router(settings, metrics=None):
     return FleetRouter(
         table, policy=settings.fleet_policy, metrics=metrics,
         proxy_timeout=settings.fleet_proxy_timeout_seconds,
-        stream_timeout=settings.stream_deadline_seconds)
+        stream_timeout=settings.stream_deadline_seconds,
+        max_spills=settings.fleet_max_spills,
+        fresh_seconds=settings.migrate_fresh_seconds)
 
 
 def run_router(host: str, port: int) -> None:

@@ -42,6 +42,16 @@ import json
 #: explicit client-side affinity pin (e.g. a conversation id)
 AFFINITY_HEADER = "x-lfkt-affinity"
 
+#: router → replica migration stamps (serving/fleet/migrate.py): the
+#: computed affinity key (the replica records it for graceful drain) and
+#: the peer whose radix tree likely still holds this conversation's KV
+#: pages (the replica pulls from it before prefilling — pull-on-remap).
+#: Router-owned: inbound copies of these are stripped before forwarding,
+#: so a client can never command a replica to pull from an arbitrary
+#: address.
+AFFINITY_KEY_HEADER = "x-lfkt-affinity-key"
+PRIOR_OWNER_HEADER = "x-lfkt-prior-owner"
+
 #: stable-prefix bytes folded into a derived key: enough to separate
 #: conversations, bounded so a megabyte opener doesn't cost a megabyte
 #: of hashing per request

@@ -39,7 +39,7 @@ class _Peer:
     """One replica's liveness record (mutated only under the table lock)."""
 
     __slots__ = ("addr", "healthy", "ejected_at", "next_probe", "backoff",
-                 "last_error", "ejections", "static")
+                 "last_error", "ejections", "static", "fresh_at")
 
     def __init__(self, addr: str, static: bool):
         self.addr = addr
@@ -50,6 +50,13 @@ class _Peer:
         self.last_error = None
         self.ejections = 0
         self.static = static         # from LFKT_FLEET_PEERS, never pruned
+        # when this replica last (re)joined the serving set: DNS
+        # scale-out discovery now, re-admission after an ejection later
+        # (static boot peers start un-fresh — a cold fleet has no prior
+        # owner to pull from).  Drives the router's pull-on-remap stamp
+        # (migrate.py): a freshly (re)joined owner probably restarted
+        # cold while its conversations' pages live on the spill target.
+        self.fresh_at = 0.0 if static else time.time()
 
 
 class PeerTable:
@@ -129,6 +136,19 @@ class PeerTable:
             p = self._peers.get(addr)
             return p is not None and p.healthy
 
+    def is_fresh(self, addr: str, window: float) -> bool:
+        """True iff ``addr`` (re)joined the serving set within
+        ``window`` seconds — the router's cue that the rendezvous owner
+        probably restarted cold and should be stamped with a prior
+        owner to pull warm pages from (``LFKT_MIGRATE_FRESH_SECONDS``;
+        0 disables)."""
+        if window <= 0:
+            return False
+        with self._lock:
+            p = self._peers.get(addr)
+            return (p is not None and p.fresh_at > 0
+                    and time.time() - p.fresh_at < window)
+
     def eject(self, addr: str, reason: str) -> None:
         """Mark a replica dead with attribution (prober or router-observed
         failure).  Repeated ejections before a successful probe double the
@@ -163,6 +183,8 @@ class PeerTable:
             p.healthy = True
             p.backoff = 0.0
             p.last_error = None
+            if was_dead:
+                p.fresh_at = time.time()
         if was_dead:
             logger.info("fleet: re-admitted replica %s", addr)
 

@@ -45,7 +45,8 @@ import logging
 import signal
 import time
 
-from .affinity import affinity_key, rendezvous_rank
+from .affinity import (AFFINITY_KEY_HEADER, PRIOR_OWNER_HEADER,
+                       affinity_key, rendezvous_rank)
 
 logger = logging.getLogger(__name__)
 
@@ -73,7 +74,9 @@ class FleetRouter:
 
     def __init__(self, peers, policy: str = "affinity", metrics=None,
                  proxy_timeout: float = 5.0,
-                 stream_timeout: float = 300.0):
+                 stream_timeout: float = 300.0,
+                 max_spills: int = 3,
+                 fresh_seconds: float = 600.0):
         if policy not in ("affinity", "roundrobin"):
             raise ValueError(
                 f"LFKT_FLEET_POLICY must be affinity|roundrobin, "
@@ -83,13 +86,15 @@ class FleetRouter:
         self.metrics = metrics
         self.proxy_timeout = proxy_timeout
         self.stream_timeout = stream_timeout
+        self.max_spills = max(0, int(max_spills))
+        self.fresh_seconds = float(fresh_seconds)
         self._rr = 0
         self.started = int(time.time())
         #: monotonic counters for /health (the /metrics twins are inc'd
         #: at event time); plain ints mutated on the one event loop
         self.counters = {
             "proxied": 0, "spills": 0, "mid_stream_aborts": 0,
-            "no_replica_503s": 0,
+            "no_replica_503s": 0, "budget_503s": 0,
         }
 
     # -- telemetry ---------------------------------------------------------
@@ -331,16 +336,20 @@ class FleetRouter:
                 if content_length else b"")
         return method, target, headers, raw_headers, body
 
-    def _write_simple(self, writer, status: int, ctype: str, body) -> None:
+    def _write_simple(self, writer, status: int, ctype: str, body,
+                      extra_headers: dict | None = None) -> None:
         if isinstance(body, str):
             body = body.encode()
         reason = {200: "OK", 503: "Service Unavailable",
                   408: "Request Timeout",
                   501: "Not Implemented"}.get(status, "")
+        extra = "".join(f"{k}: {v}\r\n"
+                        for k, v in (extra_headers or {}).items())
         writer.write(
             f"HTTP/1.1 {status} {reason}\r\n"
             f"content-type: {ctype}\r\n"
             f"content-length: {len(body)}\r\n"
+            f"{extra}"
             "connection: close\r\n\r\n".encode() + body)
 
     async def _handle_inner(self, reader, writer) -> None:
@@ -375,26 +384,69 @@ class FleetRouter:
         # forward the request with hop-by-hop headers rewritten: the
         # backend sees connection: close (EOF = end of response) and an
         # exact content-length; everything else (traceparent, affinity
-        # header, content-type) passes through
-        fwd = [f"{method} {target} HTTP/1.1\r\n".encode()]
+        # header, content-type) passes through.  The head is rebuilt per
+        # ATTEMPT: the migration stamps below name the peer being tried
+        base = []
         for line in raw_headers:
-            if line.split(b":", 1)[0].strip().lower() in _HOP_HEADERS \
-                    + (b"content-length", b"host"):
+            lname = line.split(b":", 1)[0].strip().lower()
+            if lname in _HOP_HEADERS + (b"content-length", b"host",
+                                        AFFINITY_KEY_HEADER.encode(),
+                                        PRIOR_OWNER_HEADER.encode()):
                 continue
-            fwd.append(line)
-        fwd.append(f"host: {owner or 'fleet'}\r\n".encode())
-        if body or method in ("POST", "PUT", "PATCH"):
-            fwd.append(f"content-length: {len(body)}\r\n".encode())
-        fwd.append(b"connection: close\r\n\r\n")
-        head = b"".join(fwd)
+            base.append(line)
+
+        def build_head(addr: str) -> bytes:
+            fwd = [f"{method} {target} HTTP/1.1\r\n".encode()]
+            fwd.extend(base)
+            fwd.append(f"host: {addr}\r\n".encode())
+            if body or method in ("POST", "PUT", "PATCH"):
+                fwd.append(f"content-length: {len(body)}\r\n".encode())
+            if self.policy == "affinity" and source != "opaque":
+                # migration stamps (serving/fleet/migrate.py): the key
+                # lets the replica record this conversation for graceful
+                # drain; prior-owner names the peer whose radix tree
+                # still holds its pages — set when this attempt is OFF
+                # the rendezvous owner (spill, ejection), or when the
+                # owner itself was (re)admitted recently enough that a
+                # restart/scale-out likely left it cold (pull-on-remap)
+                fwd.append(f"{AFFINITY_KEY_HEADER}: {key}\r\n".encode())
+                prior = None
+                if owner is not None and addr != owner:
+                    prior = owner
+                elif addr == owner and len(order) > 1 \
+                        and self.peers.is_fresh(addr, self.fresh_seconds):
+                    prior = order[1]
+                if prior is not None:
+                    fwd.append(
+                        f"{PRIOR_OWNER_HEADER}: {prior}\r\n".encode())
+            fwd.append(b"connection: close\r\n\r\n")
+            return b"".join(fwd)
 
         sent: list = []
         t0 = time.time()
+        spills = 0
         for addr in order:
             if not self.peers.is_healthy(addr):
                 continue
+            if spills > self.max_spills:
+                # retry budget (LFKT_FLEET_MAX_SPILLS): a request that
+                # keeps killing its peer is more likely poison than
+                # victim — stop walking the rendezvous order before it
+                # fells the whole fleet; the client backs off instead
+                self.counters["budget_503s"] += 1
+                self._emit("inc", "fleet_spills_total", reason="budget")
+                self._write_simple(
+                    writer, 503, "application/json",
+                    json.dumps({"detail": f"spill budget exhausted after "
+                                          f"{spills} failed replays "
+                                          "(LFKT_FLEET_MAX_SPILLS)"}),
+                    {"retry-after": max(
+                        1, int(self.peers.backoff_seconds))})
+                await writer.drain()
+                return
             try:
-                await self._proxy_attempt(addr, head, body, writer, sent)
+                await self._proxy_attempt(addr, build_head(addr), body,
+                                          writer, sent)
             except _BackendError as e:
                 self.peers.eject(addr, f"proxy {e.reason}")
                 self._emit("set_gauge", "fleet_peers_healthy",
@@ -412,6 +464,7 @@ class FleetRouter:
                     return
                 self.counters["spills"] += 1
                 self._emit("inc", "fleet_spills_total", reason="ejected")
+                spills += 1
                 continue
             # success
             self.counters["proxied"] += 1

@@ -263,6 +263,41 @@ class Settings:
     # router: backend connect + response-head deadline; body progress
     # rides stream_deadline_seconds
     fleet_proxy_timeout_seconds: float = 5.0
+    # router: per-request spill-replay budget — replicas tried beyond the
+    # rendezvous owner before the router answers 503 + Retry-After
+    # (fleet_spills_total{reason="budget"}) instead of walking the whole
+    # rendezvous order on a poisoned request
+    fleet_max_spills: int = 3
+    # -- fleet KV migration (serving/fleet/migrate.py; docs/RUNBOOK.md
+    # "Surviving pod churn") -----------------------------------------------
+    # arm warm-page migration on this replica: the page service +
+    # pull-on-remap client + graceful drain-push + scale-out warm-up
+    # (requires LFKT_KV_PAGED=1; off = all paths byte-for-byte unchanged)
+    migrate: bool = False
+    # migration page-service bind address
+    migrate_bind: str = "0.0.0.0"
+    # migration page-service port (0 = ephemeral; peers discover the
+    # bound port through the /health "migration" block, never by config)
+    migrate_port: int = 8471
+    # this replica's own fleet address (the host:port peers reach its
+    # HTTP port on) — excluded from drain-successor ranking; in k8s the
+    # downward-API pod IP (helm/templates/deployment.yaml)
+    migrate_self: str = ""
+    # one migration wire hop's budget; pulls are additionally clipped to
+    # the request's remaining deadline (a dead peer costs milliseconds,
+    # never a hang)
+    migrate_timeout_seconds: float = 2.0
+    # hottest radix prefixes moved per peer (scale-out warm-up pulls
+    # them, graceful drain pushes them)
+    migrate_top_k: int = 8
+    # graceful drain: total budget for pushing hot prefixes to the
+    # rendezvous successors before termination proceeds (added to the
+    # pod's terminationGracePeriodSeconds by the chart)
+    migrate_drain_seconds: float = 5.0
+    # router: a peer added or readmitted within this window is "fresh"
+    # (cold cache) — requests it owns carry a prior-owner hint so the
+    # pod can pull warm pages before prefilling (0 disables the hint)
+    migrate_fresh_seconds: float = 600.0
     # live manifest reload (POST /admin/models/reload, SIGHUP): bounded
     # wait for a removed model's in-flight requests and its radix
     # namespace's pinned pages before the weights release
@@ -423,6 +458,32 @@ KNOBS: dict[str, Knob] = _register(
     Knob("LFKT_FLEET_PROXY_TIMEOUT_SECONDS", float,
          "router: backend connect + response-head deadline",
          serving=True),
+    Knob("LFKT_FLEET_MAX_SPILLS", int,
+         "router: spill replays per request before 503 + Retry-After "
+         "(fleet_spills_total{reason=budget})", serving=True),
+    # -- fleet KV migration (serving/fleet/migrate.py) ---------------------
+    Knob("LFKT_MIGRATE", bool,
+         "warm KV-page migration: pull-on-remap + graceful drain-push + "
+         "scale-out warm-up (requires LFKT_KV_PAGED=1)", serving=True),
+    Knob("LFKT_MIGRATE_BIND", str, "migration page-service bind address"),
+    Knob("LFKT_MIGRATE_PORT", int,
+         "migration page-service port (0 = ephemeral; discovered via "
+         "/health)", serving=True),
+    Knob("LFKT_MIGRATE_SELF", str,
+         "this replica's own fleet address host:port (drain-successor "
+         "self-exclusion)", serving=True),
+    Knob("LFKT_MIGRATE_TIMEOUT_SECONDS", float,
+         "one migration wire hop's budget; pulls also clip to the "
+         "request's remaining deadline", serving=True),
+    Knob("LFKT_MIGRATE_TOP_K", int,
+         "hottest prefixes moved per peer (warm-up pulls, drain pushes)",
+         serving=True),
+    Knob("LFKT_MIGRATE_DRAIN_SECONDS", float,
+         "graceful drain: total hot-page push budget before termination "
+         "proceeds", serving=True),
+    Knob("LFKT_MIGRATE_FRESH_SECONDS", float,
+         "router: peers (re)admitted within this window carry a "
+         "prior-owner hint for pull-on-remap (0 disables)", serving=True),
     Knob("LFKT_RELOAD_DRAIN_SECONDS", float,
          "live model removal: bounded wait for in-flight requests + "
          "pinned namespace pages before weights release", serving=True),

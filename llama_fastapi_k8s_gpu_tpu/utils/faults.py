@@ -46,7 +46,16 @@ logger = logging.getLogger(__name__)
 #: short frame then closes — each must leave the decode replica
 #: DEGRADED-but-serving via local-prefill fallback, never hung.
 POINTS = ("decode_step", "prefill", "load", "recover",
-          "peer_dead", "slow_wire", "truncated_frame")
+          "peer_dead", "slow_wire", "truncated_frame",
+          # KV migration (serving/fleet/migrate.py): ``migrate_pull``
+          # fires inside the puller's wire hop (a remap-triggered page
+          # pull degrades to local recompute), ``migrate_push`` inside
+          # the migration page service's send path (a peer pulling from
+          # this pod sees a torn stream), ``drain_push`` inside the
+          # DRAINING pod's push loop (a failed handoff degrades to
+          # normal termination) — every mode must leave serving correct
+          # and the shutdown budget honored, never a hang.
+          "migrate_pull", "migrate_push", "drain_push")
 _MODES = ("error", "oom", "slow")
 
 

@@ -425,3 +425,157 @@ def test_acquire_walk_exception_unpins(monkeypatch):
     monkeypatch.setattr(KVPool, "_restore_node", _boom)
     assert pool.acquire(ab, 24) is None
     assert pool.occupancy()["pages_pinned"] == 0
+
+
+# ---------------------------------------------------------------------------
+# wire import/export — the fleet-migration surface (serving/fleet/migrate.py)
+# ---------------------------------------------------------------------------
+
+def test_export_import_round_trip_bitwise():
+    """Pages exported from one pool and imported into a fresh one restore
+    bit-identically — the whole migration contract — and a re-import of
+    the same prefix dedups (LRU touch, zero new pages stored)."""
+    src = KVPool(CFG, page_tokens=T, n_pages=8)
+    dst = KVPool(CFG, page_tokens=T, n_pages=8)
+    ring = marked_ring()
+    ids = list(range(1, 25))                       # 3 full pages
+    assert src.commit(ids, ring) == 3
+    lease = src.acquire(ids, 24)
+    leaves = src.export_pages(lease)
+    src.release(lease)
+
+    assert dst.import_pages(ids, leaves, namespace="m") == 24
+    assert dst.counters["imported_pages"] == 3
+    # dedup: the same stack again indexes nothing new
+    assert dst.import_pages(ids, leaves, namespace="m") == 24
+    assert dst.counters["imported_pages"] == 3
+
+    got = dst.acquire(ids, 24, namespace="m")
+    assert got is not None
+    assert_prefix_equal(dst.restore(got, init_cache(CFG)), ring, 24)
+    dst.release(got)
+    assert dst.occupancy()["pages_pinned"] == 0
+
+
+def test_import_pages_geometry_mismatch_raises():
+    """A stack whose page count disagrees with ids is a wire-geometry
+    bug and must refuse loudly, not index garbage."""
+    src = KVPool(CFG, page_tokens=T, n_pages=8)
+    dst = KVPool(CFG, page_tokens=T, n_pages=8)
+    ids = list(range(1, 25))
+    src.commit(ids, marked_ring())
+    lease = src.acquire(ids, 24)
+    leaves = src.export_pages(lease)
+    src.release(lease)
+    with pytest.raises(ValueError):
+        dst.import_pages(ids + list(range(90, 98)), leaves)
+    assert dst.occupancy()["pages_pinned"] == 0
+
+
+def test_import_degrades_when_pool_pinned_solid():
+    """import_pages against a fully pinned pool degrades to the leading
+    portion that fits (here: nothing) — never blocks, never corrupts —
+    and succeeds once the pin releases."""
+    src = KVPool(CFG, page_tokens=T, n_pages=8)
+    dst = KVPool(CFG, page_tokens=T, n_pages=2)
+    ring = marked_ring()
+    ids = list(range(1, 25))
+    src.commit(ids, ring)
+    lease = src.acquire(ids, 24)
+    leaves = src.export_pages(lease)
+    src.release(lease)
+
+    blocker = list(range(100, 117))                # pins both dst pages
+    assert dst.commit(blocker, ring) == 2
+    pin = dst.acquire(blocker, 16)
+    assert pin is not None
+    assert dst.import_pages(ids, leaves) == 0      # pinned solid: degrade
+    dst.release(pin)
+    assert dst.import_pages(ids, leaves) >= T      # now pages can evict
+    assert dst.occupancy()["pages_pinned"] == 0
+
+
+def test_import_pages_races_concurrent_eviction():
+    """import_pages of one prefix racing commits+acquires that churn the
+    LRU (evicting that same prefix between rounds) must only ever dedup
+    or degrade — at the end the tree restores the prefix bitwise or
+    reports an honest miss, pins at zero, no corruption."""
+    import threading
+
+    pool = KVPool(CFG, page_tokens=T, n_pages=4)   # tiny: constant evict
+    ring = marked_ring()
+    ids = list(range(1, 25))                       # 3 of the 4 pages
+    donor = KVPool(CFG, page_tokens=T, n_pages=8)
+    donor.commit(ids, ring)
+    lease = donor.acquire(ids, 24)
+    leaves = donor.export_pages(lease)
+    donor.release(lease)
+
+    stop = threading.Event()
+    errors = []
+
+    def importer():
+        try:
+            while not stop.is_set():
+                got = pool.import_pages(ids, leaves, namespace="m")
+                assert got in (0, 8, 16, 24)
+        except Exception as e:  # noqa: BLE001 — surfaced to the assert
+            errors.append(e)
+
+    def churner():
+        try:
+            rounds = 0
+            while not stop.is_set():
+                other = list(range(200 + rounds % 7 * 32,
+                                   200 + rounds % 7 * 32 + 17))
+                pool.commit(other, ring)           # evicts the import's LRU
+                l2 = pool.acquire(other, 16)
+                if l2 is not None:
+                    pool.release(l2)
+                rounds += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=importer),
+               threading.Thread(target=churner)]
+    for t in threads:
+        t.start()
+    import time as _time
+    _time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    assert pool.occupancy()["pages_pinned"] == 0
+
+    # the tree is still coherent: a final import then acquire restores
+    # the prefix bitwise
+    covered = pool.import_pages(ids, leaves, namespace="m")
+    assert covered >= T
+    final = pool.acquire(ids[:covered], covered, namespace="m")
+    assert final is not None
+    assert_prefix_equal(pool.restore(final, init_cache(CFG)), ring, covered)
+    pool.release(final)
+    assert pool.occupancy()["pages_pinned"] == 0
+
+
+def test_hot_prefixes_ranks_by_recency():
+    """hot_prefixes: leaf chains only, hottest (most recently touched)
+    first, capped at k — the drain/warm-up candidate list."""
+    pool = KVPool(CFG, page_tokens=T, n_pages=8)
+    ring = marked_ring()
+    a = list(range(1, 17))
+    b = list(range(100, 117))
+    pool.commit(a, ring, namespace="x")
+    pool.commit(b, ring, namespace="y")
+    # touch a AFTER b so a is hotter
+    assert pool.match_len(a, namespace="x") == 16
+    lease = pool.acquire(a, 16, namespace="x")
+    pool.release(lease)
+
+    rows = pool.hot_prefixes(8)
+    assert [r["namespace"] for r in rows] == ["x", "y"]
+    assert rows[0]["ids"] == a and rows[0]["tokens"] == 16
+    assert rows[1]["ids"] == b[:16]
+    assert pool.hot_prefixes(1) == rows[:1]
+    assert pool.hot_prefixes(0) == []

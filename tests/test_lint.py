@@ -534,7 +534,8 @@ def test_ci_gate_aggregates_lint_and_manifest():
     names = {c["name"] for c in doc["checks"]}
     assert names == {"lfkt-lint", "lint-concurrency", "check-manifest",
                      "incident-schema", "disagg-wire-schema",
-                     "decode-loop-parity", "fleet-route-parity"}
+                     "decode-loop-parity", "fleet-route-parity",
+                     "chaos-drill"}
     assert all(c["exit"] == 0 for c in doc["checks"])
 
 

@@ -1,0 +1,565 @@
+#!/usr/bin/env python
+"""Chaos drill: kill, tear, slow, drain and reload a REAL replica fleet
+mid-replay, and assert the KV-survivability invariants (ISSUE 17).
+
+Boots two real server processes (tiny GGUF on CPU by default, or
+``--model-dir`` for a real model) behind an in-process prefix-affinity
+router, replays multi-turn conversations, then injects one failure per
+scenario and checks the documented recovery story
+(docs/RUNBOOK.md "Surviving pod churn"):
+
+``sigkill``
+    SIGKILL the rendezvous owner mid-stream.  Invariants: the torn
+    stream is the ONLY client-visible error; the survivor keeps
+    answering 200 with its pull degrade attributed (the stamped prior
+    owner is dead); the restarted owner pulls its conversations back
+    (``kv_migration_pulls_total{reason="remap"}``) and its first batch
+    beats the survivor's cold spill-over batch on token-weighted prefix
+    reuse by >= 2x; ``pages_pinned == 0`` fleet-wide at the end.
+``drain``
+    SIGTERM the owner.  Invariants: shutdown completes within the grace
+    budget; the successor shows migration pulls BEFORE the dying pod
+    exits and its first post-drain turn reuses prompt tokens.
+``torn-wire`` / ``slow-wire``
+    Arm ``migrate_push:error`` / ``migrate_pull:slow`` (utils/faults.py,
+    via ``LFKT_FAULTS``) on a replica, then force pulls.  Invariants:
+    every degrade is attributed in /health + /metrics, requests still
+    answer 200, nothing hangs past its deadline.
+``reload``
+    Rewrite the fleet manifest mid-replay to remove the owner, drive
+    spill-over traffic, then restore it.  Invariants: zero client-visible
+    errors; the returning owner is served traffic again.
+
+Exit code 0 = every requested scenario held its invariants.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py                # all
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py sigkill drain
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --model-dir /models
+
+The tier-1 pytest port of the same invariants lives in
+tests/test_chaos.py (ci_gate's ``chaos-drill`` check runs its smoke
+subset); this CLI is the operator-facing version for drilling a real
+checkout — slower, chattier, and runnable against a real model.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fleet plumbing (the tests/test_fleet.py idiom, self-contained so the
+# drill runs from a bare checkout without pytest)
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _body(conv: int, history: list | None = None) -> bytes:
+    return json.dumps({
+        "bot_profile": {
+            "name": f"Bot{conv}",
+            "appearance": "tall, green eyes, red hair, calm voice",
+            "system_prompt": f"You are concise assistant #{conv}.",
+        },
+        "user_profile": {"name": "Sam"},
+        "context": history or [{"turn": "user", "message": "hello"}],
+    }).encode()
+
+
+def _opener(conv: int) -> list:
+    return [{"turn": "user",
+             "message": f"Hello bot {conv}! The quick brown fox jumps "
+                        "over the lazy dog near the riverbank while "
+                        "autumn leaves drift slowly down."}]
+
+
+def _post(port: int, body: bytes, path: str = "/response",
+          timeout: float = 300.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _get_json(port: int, path: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _metric_sum(port: int, name: str, **labels) -> float:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    total = 0.0
+    want = [f'{k}="{v}"' for k, v in labels.items()]
+    for ln in text.splitlines():
+        head, _, val = ln.rpartition(" ")
+        if (head == name or head.startswith(name + "{")) \
+                and all(w in head for w in want):
+            total += float(val)
+    return total
+
+
+def _proc_env(port: int, model_dir: str, model_name: str,
+              **extra) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+        "LFKT_MODEL_DIR": model_dir,
+        "LFKT_MODEL_NAME": model_name,
+        "LFKT_HOST": "127.0.0.1",
+        "LFKT_PORT": str(port),
+        "LFKT_MAX_CONTEXT_TOKENS": "512",
+        "LFKT_PREFILL_BUCKETS": "64,128,256",
+        "LFKT_MAX_GEN_TOKENS": "8",
+        "LFKT_DECODE_CHUNK": "4",
+        "LFKT_TEMPERATURE": "0.0",
+        "LFKT_KV_PAGED": "1",
+        "LFKT_KV_PAGE_TOKENS": "16",
+    })
+    env.update({k: str(v) for k, v in extra.items()})
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+class Fleet:
+    """Two migrating replicas + an in-process affinity router."""
+
+    def __init__(self, model_dir: str, model_name: str,
+                 boot_deadline: float = 420.0):
+        self.model_dir = model_dir
+        self.model_name = model_name
+        self.boot_deadline = boot_deadline
+        self.ports = [_free_port(), _free_port()]
+        self.router_port = _free_port()
+        self.addrs = [f"127.0.0.1:{p}" for p in self.ports]
+        self.fleet = ",".join(self.addrs)
+        self.procs: dict[int, subprocess.Popen] = {}
+        self.table = None
+        self._router_stop = None
+        self._router_thread = None
+
+    def replica_env(self, port: int, **extra) -> dict:
+        env = {
+            "LFKT_MIGRATE": "1",
+            "LFKT_MIGRATE_BIND": "127.0.0.1",
+            "LFKT_MIGRATE_PORT": "0",
+            "LFKT_MIGRATE_SELF": f"127.0.0.1:{port}",
+            "LFKT_FLEET_PEERS": self.fleet,
+            "LFKT_MIGRATE_TOP_K": "1",
+            "LFKT_MIGRATE_TIMEOUT_SECONDS": "10.0",
+            "LFKT_MIGRATE_DRAIN_SECONDS": "5.0",
+        }
+        env.update(extra)
+        return env
+
+    def spawn(self, port: int, **extra) -> subprocess.Popen:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "llama_fastapi_k8s_gpu_tpu.server"],
+            env=_proc_env(port, self.model_dir, self.model_name,
+                          **self.replica_env(port, **extra)),
+            cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        self.procs[port] = proc
+        return proc
+
+    def wait_ready(self, port: int) -> None:
+        proc = self.procs[port]
+        deadline = time.time() + self.boot_deadline
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"replica :{port} died during boot:\n"
+                    f"{proc.stderr.read().decode()[-3000:]}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/health/ready",
+                        timeout=5) as r:
+                    if r.status == 200:
+                        return
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.5)
+        raise AssertionError(f"replica :{port} never became ready")
+
+    def start(self, extra_by_port: dict | None = None) -> None:
+        extra_by_port = extra_by_port or {}
+        for port in self.ports:
+            self.spawn(port, **extra_by_port.get(port, {}))
+        for port in self.ports:
+            self.wait_ready(port)
+        self.start_router()
+
+    def start_router(self) -> None:
+        import asyncio
+
+        from llama_fastapi_k8s_gpu_tpu.serving.fleet.peers import PeerTable
+        from llama_fastapi_k8s_gpu_tpu.serving.fleet.router import (
+            FleetRouter,
+        )
+        from llama_fastapi_k8s_gpu_tpu.utils.metrics import Metrics
+
+        self.table = PeerTable(peers=self.addrs, probe_seconds=0.3,
+                               backoff_seconds=0.3,
+                               probe_timeout=2.0).start()
+        self.router = FleetRouter(self.table, policy="affinity",
+                                  metrics=Metrics(), fresh_seconds=600.0)
+        ready = threading.Event()
+        holder: dict = {}
+
+        async def serve():
+            holder["loop"] = asyncio.get_running_loop()
+            holder["stop"] = asyncio.Event()
+            r = asyncio.Event()
+            task = asyncio.create_task(self.router.serve(
+                "127.0.0.1", self.router_port, ready_event=r,
+                stop_event=holder["stop"]))
+            await r.wait()
+            ready.set()
+            await task
+
+        self._router_thread = threading.Thread(
+            target=lambda: __import__("asyncio").run(serve()), daemon=True)
+        self._router_thread.start()
+        assert ready.wait(30), "router never became ready"
+        self._router_stop = lambda: holder["loop"].call_soon_threadsafe(
+            holder["stop"].set)
+
+    def owner_convs(self, victim: str, n: int = 3) -> list[int]:
+        """n conversation ids whose rendezvous owner is ``victim`` —
+        computed with the SAME opener the replay sends (the affinity key
+        hashes bot name + system prompt + first context message)."""
+        from llama_fastapi_k8s_gpu_tpu.serving.fleet.affinity import (
+            affinity_key,
+            rendezvous_rank,
+        )
+        out = []
+        for c in range(200, 400):
+            key, _src = affinity_key(
+                "/response", {}, _body(c, history=_opener(c)))
+            if rendezvous_rank(key, self.addrs)[0] == victim:
+                out.append(c)
+                if len(out) == n:
+                    return out
+        raise AssertionError("rendezvous never chose the victim")
+
+    def stop(self) -> None:
+        if self._router_stop is not None:
+            self._router_stop()
+        if self._router_thread is not None:
+            self._router_thread.join(10)
+        if self.table is not None:
+            self.table.stop()
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def _turn(router_port: int, histories: dict, phase: str) -> int:
+    """One replay turn per conversation; returns client-visible errors."""
+    errors = 0
+    for c, hist in histories.items():
+        try:
+            _status, raw = _post(router_port, _body(c, history=hist))
+            reply = json.loads(raw)["response"]
+        except Exception:  # noqa: BLE001 — counted, not fatal
+            errors += 1
+            reply = None
+        hist.append({"turn": "bot", "message": (reply or "...")[:400]})
+        hist.append({"turn": "user",
+                     "message": f"[{phase}] Please tell me more."})
+    return errors
+
+
+def _ratio(port: int, before: dict) -> tuple[float, dict]:
+    now = {"reused": _metric_sum(port, "prefix_cache_reused_tokens_total"),
+           "prompt": _metric_sum(port, "tokens_prompt_total")}
+    d = {k: now[k] - before.get(k, 0.0) for k in now}
+    return (d["reused"] / d["prompt"] if d["prompt"] else 0.0), now
+
+
+def _check(ok: bool, what: str) -> None:
+    if not ok:
+        raise AssertionError(what)
+    print(f"  [ok] {what}")
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_sigkill(model_dir: str, model_name: str) -> None:
+    fleet = Fleet(model_dir, model_name)
+    fleet.start()
+    try:
+        victim_port, survivor_port = fleet.ports
+        convs = fleet.owner_convs(fleet.addrs[0])
+        histories = {c: _opener(c) for c in convs}
+        _check(_turn(fleet.router_port, histories, "warm") == 0,
+               "warm replay served with zero errors")
+
+        # SIGKILL the owner mid-stream
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fleet.router_port}/response/stream",
+            data=_body(convs[0], history=histories[convs[0]]),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=60)
+        resp.readline()
+        fleet.procs[victim_port].send_signal(signal.SIGKILL)
+        fleet.procs[victim_port].wait(timeout=30)
+        t0 = time.time()
+        try:
+            while resp.readline():
+                pass
+        except Exception:  # noqa: BLE001 — a torn stream is the point
+            pass
+        _check(time.time() - t0 < 30, "torn stream terminated bounded")
+        resp.close()
+
+        before = _ratio(survivor_port, {})[1]
+        fails0 = _metric_sum(survivor_port, "kv_migration_failures_total")
+        _check(_turn(fleet.router_port, histories, "spill") == 0,
+               "spill-over replay served with zero errors")
+        cold, _ = _ratio(survivor_port, before)
+        _check(_metric_sum(survivor_port,
+                           "kv_migration_failures_total") > fails0,
+               "survivor's pull against the dead owner is attributed")
+
+        fleet.spawn(victim_port)
+        fleet.wait_ready(victim_port)
+        deadline = time.time() + 30
+        while len(fleet.table.healthy()) < 2 and time.time() < deadline:
+            time.sleep(0.3)
+        _check(len(fleet.table.healthy()) == 2, "owner re-admitted")
+        before = _ratio(victim_port, {})[1]
+        _check(_turn(fleet.router_port, histories, "back") == 0,
+               "post-restart replay served with zero errors")
+        warm, _ = _ratio(victim_port, before)
+        _check(_metric_sum(victim_port, "kv_migration_pulls_total",
+                           reason="remap") >= 1,
+               "restarted owner pulled its conversations back (remap)")
+        _check(warm >= 2.0 * cold and warm > 0.3,
+               f"warm restart ratio {warm:.3f} >= 2x cold control "
+               f"{cold:.3f}")
+        for port in fleet.ports:
+            _check(_get_json(port, "/health")["engine"]["kv_pool"]
+                   ["pages_pinned"] == 0,
+                   f"pages_pinned == 0 on :{port}")
+    finally:
+        fleet.stop()
+
+
+def scenario_drain(model_dir: str, model_name: str) -> None:
+    fleet = Fleet(model_dir, model_name)
+    fleet.start()
+    try:
+        victim_port, successor_port = fleet.ports
+        convs = fleet.owner_convs(fleet.addrs[0])
+        histories = {c: _opener(c) for c in convs}
+        _check(_turn(fleet.router_port, histories, "warm") == 0,
+               "warm replay served with zero errors")
+
+        pulls0 = _metric_sum(successor_port, "kv_migration_pulls_total")
+        proc = fleet.procs[victim_port]
+        t0 = time.time()
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        took = time.time() - t0
+        _check(took < 30, f"drain finished in {took:.1f}s (budget-bounded)")
+        _check(_metric_sum(successor_port,
+                           "kv_migration_pulls_total") > pulls0,
+               "successor pulled pages during the drain window")
+        before = _ratio(successor_port, {})[1]
+        _turn(fleet.router_port, histories, "post-drain")
+        warm, _ = _ratio(successor_port, before)
+        _check(warm > 0.0,
+               f"successor's first post-drain turn warm ({warm:.3f})")
+        _check(_get_json(successor_port, "/health")["engine"]["kv_pool"]
+               ["pages_pinned"] == 0, "pages_pinned == 0 on successor")
+    finally:
+        fleet.stop()
+
+
+def scenario_torn_wire(model_dir: str, model_name: str) -> None:
+    # the dying OWNER's page service tears every push mid-stream: the
+    # drain-commanded successor pull sees a torn stream, degrades with
+    # attribution, and never corrupts KV — shutdown stays on budget
+    fleet = Fleet(model_dir, model_name)
+    fleet.start({fleet.ports[0]: {"LFKT_FAULTS": "migrate_push:error"}})
+    _run_wire_fault(fleet)
+
+
+def scenario_slow_wire(model_dir: str, model_name: str) -> None:
+    # the SUCCESSOR's pull hop stalls far past the migration timeout:
+    # the dying pod's drain command times out (attributed on its side),
+    # the successor's stalled pull fails its deadline — and a slow wire
+    # never delays shutdown past the grace budget
+    fleet = Fleet(model_dir, model_name)
+    fleet.start({fleet.ports[1]: {
+        "LFKT_FAULTS": "migrate_pull:slow:delay=10.0",
+        "LFKT_MIGRATE_TIMEOUT_SECONDS": "2.0"}})
+    _run_wire_fault(fleet)
+
+
+def _run_wire_fault(fleet: Fleet) -> None:
+    """SIGTERM the owner with a broken migration wire: the drain must
+    degrade to normal termination (attributed on the successor), never
+    hang shutdown, and the fleet keeps serving."""
+    try:
+        victim_port, survivor_port = fleet.ports
+        convs = fleet.owner_convs(fleet.addrs[0])
+        histories = {c: _opener(c) for c in convs}
+        _check(_turn(fleet.router_port, histories, "warm") == 0,
+               "warm replay served with zero errors")
+        proc = fleet.procs[victim_port]
+        t0 = time.time()
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        took = time.time() - t0
+        _check(took < 30,
+               f"broken wire did not delay shutdown ({took:.1f}s)")
+        # the successor's degraded pulls attribute once their budget
+        # expires (the slow hop sleeps out its stall first)
+        deadline = time.time() + 30
+        while time.time() < deadline and _metric_sum(
+                survivor_port, "kv_migration_failures_total") == 0:
+            time.sleep(1.0)
+        _check(_metric_sum(survivor_port,
+                           "kv_migration_failures_total") > 0,
+               "wire degrade attributed in kv_migration_failures_total")
+        doc = _get_json(survivor_port, "/health")["migration"]
+        _check(bool(doc["last_error"]),
+               f"last_error attributed: {doc['last_error']!r:.80}")
+        _check(_turn(fleet.router_port, histories, "after") == 0,
+               "replay continues on the survivor despite the broken wire")
+        _check(_get_json(survivor_port, "/health")["engine"]["kv_pool"]
+               ["pages_pinned"] == 0, "pages_pinned == 0 on survivor")
+    finally:
+        fleet.stop()
+
+
+def scenario_reload(model_dir: str, model_name: str) -> None:
+    # live manifest reload mid-drill (POST /admin/models/reload): the
+    # owner adds then removes an aux model WHILE serving the replay —
+    # the removal drains the aux radix namespace; zero client-visible
+    # errors throughout.  Registry serving is single-engine-watchdog
+    # territory, and build_migration refuses registries by design, so
+    # this fleet runs WITHOUT migration — the invariant drilled is
+    # "reload never interrupts the replay", not page migration.
+    fleet = Fleet(model_dir, model_name)
+    path = os.path.join(model_dir, model_name)
+    registry_env = {"LFKT_MIGRATE": "0", "LFKT_MODELS": f"main={path}"}
+    fleet.start({p: dict(registry_env) for p in fleet.ports})
+    try:
+        owner_port = fleet.ports[0]
+        convs = fleet.owner_convs(fleet.addrs[0])
+        histories = {c: _opener(c) for c in convs}
+        _check(_turn(fleet.router_port, histories, "warm") == 0,
+               "warm replay served with zero errors")
+
+        done = threading.Event()
+        errs: list = []
+
+        def reload_twice():
+            try:
+                # add aux, then converge back (aux's namespace drains)
+                _post(owner_port, json.dumps(
+                    {"models": f"main={path},aux={path}"}).encode(),
+                    path="/admin/models/reload")
+                _post(owner_port, json.dumps(
+                    {"models": f"main={path}"}).encode(),
+                    path="/admin/models/reload")
+            except Exception as e:  # noqa: BLE001 — reported below
+                errs.append(e)
+            finally:
+                done.set()
+
+        threading.Thread(target=reload_twice, daemon=True).start()
+        turns = 0
+        while not done.is_set() or turns < 2:
+            _check(_turn(fleet.router_port, histories,
+                         f"reload-{turns}") == 0,
+                   f"replay turn {turns} clean during reload")
+            turns += 1
+            if turns > 20:
+                raise AssertionError("reload never completed")
+        _check(not errs, f"both reloads succeeded ({errs})")
+        models = [m["id"] for m in
+                  _get_json(owner_port, "/v1/models")["data"]]
+        _check(models == ["main"], f"registry converged back: {models}")
+    finally:
+        fleet.stop()
+
+
+SCENARIOS = {
+    "sigkill": scenario_sigkill,
+    "drain": scenario_drain,
+    "torn-wire": scenario_torn_wire,
+    "slow-wire": scenario_slow_wire,
+    "reload": scenario_reload,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("scenarios", nargs="*", default=[],
+                    choices=[*SCENARIOS, []],
+                    help="subset to run (default: all)")
+    ap.add_argument("--model-dir", default="",
+                    help="directory holding --model-name (default: write "
+                         "a tiny CPU GGUF to a temp dir)")
+    ap.add_argument("--model-name", default="tiny.gguf")
+    args = ap.parse_args()
+
+    model_dir = args.model_dir
+    if not model_dir:
+        from llama_fastapi_k8s_gpu_tpu.testing import write_tiny_llama_gguf
+        model_dir = tempfile.mkdtemp(prefix="chaos-drill-")
+        write_tiny_llama_gguf(os.path.join(model_dir, args.model_name))
+
+    failed = []
+    for name in (args.scenarios or list(SCENARIOS)):
+        print(f"[drill] scenario: {name}")
+        t0 = time.time()
+        try:
+            SCENARIOS[name](model_dir, args.model_name)
+            print(f"[drill] {name} PASS ({time.time() - t0:.1f}s)")
+        except AssertionError as e:
+            failed.append(name)
+            print(f"[drill] {name} FAIL: {e}")
+    if failed:
+        print(f"[drill] FAILED scenarios: {', '.join(failed)}")
+        return 1
+    print("[drill] PASS: all scenarios held their invariants")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

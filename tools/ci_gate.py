@@ -74,6 +74,17 @@ CHECKS: list[tuple[str, list[str]]] = [
                             "-m", "pytest", "-q", "-p", "no:cacheprovider",
                             os.path.join(ROOT, "tests", "test_fleet.py"),
                             "-k", "route_parity"]),
+    # KV-survivability smoke (ISSUE 17): the no-engine subset of
+    # tests/test_chaos.py — pull round-trip bitwise over the real wire,
+    # every migrate fault point degrading with attribution, graceful
+    # drain as a commanded pull, router stamp/strip security, and the
+    # spill-budget 503.  The full SIGKILL/drain drills (real replica
+    # processes) stay in tier-1; tools/chaos_drill.py is the operator
+    # CLI twin.
+    ("chaos-drill", ["env", "JAX_PLATFORMS=cpu", sys.executable,
+                     "-m", "pytest", "-q", "-p", "no:cacheprovider",
+                     os.path.join(ROOT, "tests", "test_chaos.py"),
+                     "-k", "smoke"]),
 ]
 
 

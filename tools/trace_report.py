@@ -133,14 +133,18 @@ def render_trace(trace: dict) -> str:
                     suffix += f" {_fmt_bytes(ev['bytes'])}"
                 duration_bar(at, host_s, "░", ev["name"], suffix)
                 continue
-            if ev["name"] == "disagg_recv" and host_s is not None:
-                # disaggregated prefill (▓, serving/disagg/): one page
-                # transfer over the wire, rendered DMA-style — the hop's
-                # cost next to the local restore/suffix-prefill it buys
+            if ev["name"] in ("disagg_recv", "kv_migrate_pull") \
+                    and host_s is not None:
+                # wire-delivered KV pages (▓): a disagg prefill transfer
+                # (serving/disagg/) or a fleet migration pull
+                # (serving/fleet/migrate.py) — the hop's cost next to
+                # the local restore/suffix-prefill it buys
                 suffix = (f"pages={ev.get('pages', '?')}"
                           f" t={ev.get('tokens', '?')}")
                 if ev.get("bytes") is not None:
                     suffix += f" {_fmt_bytes(ev['bytes'])}"
+                if ev.get("reason") is not None:
+                    suffix += f" reason={ev['reason']}"
                 duration_bar(at, host_s, "▓", ev["name"], suffix)
                 continue
             mark = min(int(at / total * WIDTH), WIDTH - 1)
