@@ -562,4 +562,13 @@ class CallGraph:
 
 
 def build_graph(ctx: Context) -> CallGraph:
-    return CallGraph(ctx)
+    """One CallGraph per lint pass: concurrency and taint both ride the
+    resolution surface, and building it twice would double the dominant
+    cost of a whole-package run — so the graph memoizes on the Context.
+    ``extract_facts`` stays idempotent-per-caller (facts accumulate per
+    key), so sharing is safe across checkers."""
+    graph = getattr(ctx, "_lint_callgraph", None)
+    if graph is None:
+        graph = CallGraph(ctx)
+        ctx._lint_callgraph = graph
+    return graph

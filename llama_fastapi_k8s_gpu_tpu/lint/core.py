@@ -163,11 +163,14 @@ def _py_files(root: str) -> list[str]:
 def _checkers() -> list[tuple[dict, Callable[[Context], list[Finding]]]]:
     # imported lazily so a syntax error in one checker names itself cleanly
     from . import (concurrency, configreg, deadcode, degrade, donation,
-                   jit, kernels, locks, obsreg, perf, resources)
+                   jit, kernels, locks, obsreg, perf, resources, taint,
+                   wire)
 
+    # taint rides concurrency's --changed cache doc (it augments
+    # inc["out"] with its own per-file summaries), so it must run after
     return [(mod.RULES, mod.check)
-            for mod in (locks, concurrency, jit, configreg, obsreg,
-                        kernels, perf, resources, donation, degrade,
+            for mod in (locks, concurrency, taint, jit, configreg, obsreg,
+                        wire, kernels, perf, resources, donation, degrade,
                         deadcode)]
 
 

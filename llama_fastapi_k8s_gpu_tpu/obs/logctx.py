@@ -15,6 +15,14 @@ level, logger, message, request_id, plus exception text when present) —
 the machine-parseable access log the k8s log pipeline ingests.  Install
 both with :func:`setup_json_logging` (server/__main__.py does for
 production; tests attach them to private handlers).
+
+:func:`sanitize_text` is THE log-injection declassifier: any
+request-derived string (a model name off the admin manifest, an explicit
+affinity header, a peer-supplied ejection/health reason, a wire-frame
+error detail) must pass through it before interpolation into a log
+record or an outbound header.  lfkt-lint's taint analyzer (lint/taint.py
+TAINT003) enforces that statically — ``sanitize_text`` is the registered
+sanitizer for the ``log`` and ``header`` sink classes.
 """
 
 from __future__ import annotations
@@ -23,6 +31,27 @@ import contextlib
 import contextvars
 import json
 import logging
+import re
+
+#: C0 control bytes (including CR/LF — the log-forging pair) + DEL; the
+#: text log format is line-framed and the raw HTTP header format is
+#: CRLF-framed, so any of these inside an attacker-controlled string can
+#: forge a record boundary
+_CONTROL_BYTES = re.compile(r"[\x00-\x1f\x7f]+")
+
+
+def sanitize_text(value, limit: int = 512) -> str:
+    """``value`` as a single-line, bounded, printable string.  Control
+    bytes (CR/LF included) collapse to one space and the result is
+    truncated to ``limit`` chars — enough to neutralize log-record
+    forging and header-splitting while keeping the payload legible for
+    attribution.  Accepts any type (peer JSON fields arrive as whatever
+    the peer sent); never raises."""
+    text = value if isinstance(value, str) else str(value)
+    text = _CONTROL_BYTES.sub(" ", text)
+    if len(text) > limit:
+        text = text[:limit] + "..."
+    return text
 
 #: the active request id ("-" outside any request scope)
 _REQUEST_ID: contextvars.ContextVar[str] = contextvars.ContextVar(

@@ -45,7 +45,7 @@ import uuid
 from ..obs import flightrec as _flightrec
 from ..obs import memledger as _memledger
 from ..obs.devtime import DEVTIME
-from ..obs.logctx import access_logger, bind_request_id
+from ..obs.logctx import access_logger, bind_request_id, sanitize_text
 from ..obs.slo import SLOEngine
 from ..obs.trace import TRACER, Tracer
 from ..serving.fleet.affinity import AFFINITY_KEY_HEADER, PRIOR_OWNER_HEADER
@@ -1123,10 +1123,12 @@ def create_app(engine=None, settings: Settings | None = None,
         async with app.state.reload_busy:
             try:
                 doc = await _do_reload(live.models, live.default_model)
+                # model names may come from a POSTed manifest
                 logger.info("%s reload: added=%s removed=%s default=%s",
-                            origin, doc["added"],
-                            [r["name"] for r in doc["removed"]],
-                            doc["default_model"])
+                            origin, sanitize_text(str(doc["added"])),
+                            sanitize_text(
+                                str([r["name"] for r in doc["removed"]])),
+                            sanitize_text(doc["default_model"]))
             except HTTPException as e:
                 logger.error("%s reload refused: %s", origin, e.detail)
             except Exception as e:  # noqa: BLE001 — a failed background

@@ -34,6 +34,7 @@ import time
 import numpy as np
 
 from ...obs import flightrec as _flightrec
+from ...obs.logctx import sanitize_text
 from ...utils.health import DEGRADED, READY
 from . import wire
 from .transport import connect
@@ -287,6 +288,9 @@ class DisaggClient:
         """Permanent handshake refusal (schema/geometry): reconnecting
         cannot fix a mis-deployed fleet — pin the attribution, serve
         local prefill for the process lifetime."""
+        # msg may quote peer-supplied frame fields (wire "error" text);
+        # it reaches the log and the /health echo
+        msg = sanitize_text(msg)
         self._refused = msg
         logger.error("disagg handshake refused — serving LOCAL prefill "
                      "for the process lifetime: %s", msg)
@@ -321,6 +325,11 @@ class DisaggClient:
         self._fallback("peer_dead", msg)
 
     def _fallback(self, reason: str, msg: str) -> None:
+        # both can carry peer-supplied frame bytes (the ERR "code" field
+        # flows into reason); they reach the log, /health and a metric
+        # label
+        reason = sanitize_text(reason, limit=64)
+        msg = sanitize_text(msg)
         with self._lock:
             self.counters["local_fallbacks"] += 1
             self.last_error = f"{reason}: {msg}"

@@ -26,6 +26,7 @@ import socket
 import threading
 import time
 
+from ...obs.logctx import sanitize_text
 from ...obs.memledger import register_component
 from ...utils.faults import FAULTS, FaultError
 from . import wire
@@ -143,7 +144,7 @@ class PrefillServer:
                 self._count("handshake_refusals")
                 self._emit("inc", "disagg_handshake_refusals_total")
                 logger.error("disagg handshake refused for %s: %s",
-                             peer, mismatch)
+                             peer, sanitize_text(mismatch))
                 conn.send_frame(wire.FRAME_ERR, {
                     "rid": None, "code": "geometry", "error": mismatch})
                 return

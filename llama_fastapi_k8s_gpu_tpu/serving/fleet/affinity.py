@@ -39,6 +39,8 @@ from __future__ import annotations
 import hashlib
 import json
 
+from ...obs.logctx import sanitize_text
+
 #: explicit client-side affinity pin (e.g. a conversation id)
 AFFINITY_HEADER = "x-lfkt-affinity"
 
@@ -80,7 +82,10 @@ def affinity_key(path: str, headers: dict, body: bytes) -> tuple[str, str]:
     an unparseable body degrades to the opaque digest."""
     hdr = headers.get(AFFINITY_HEADER, "")
     if hdr:
-        return f"h:{hdr}", "header"
+        # client-settable bytes that ride into the forwarded
+        # x-lfkt-affinity-key stamp and the access log — strip control
+        # bytes (header splitting / log forging) before either
+        return "h:" + sanitize_text(hdr, limit=128), "header"
     doc = None
     if body:
         try:
@@ -91,7 +96,9 @@ def affinity_key(path: str, headers: dict, body: bytes) -> tuple[str, str]:
         if path.startswith("/v1/"):
             user = doc.get("user")
             if isinstance(user, str) and user:
-                return f"u:{user}", "conversation"
+                # body-supplied bytes that, like the explicit header,
+                # ride into the forwarded stamp and the access log
+                return "u:" + sanitize_text(user, limit=128), "conversation"
             msgs = doc.get("messages") or []
             sys_c = _first_content(msgs, "system")
             usr_c = _first_content(msgs, "user")

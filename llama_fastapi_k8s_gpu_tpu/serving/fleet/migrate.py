@@ -65,6 +65,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ...obs.logctx import sanitize_text
 from ...utils.faults import FAULTS, FaultError
 from ..disagg import wire
 from ..disagg.transport import FrameConn, FrameSender, connect
@@ -178,7 +179,7 @@ class MigrationServer:
                 # of corrupted KV
                 self._count("handshake_refusals")
                 logger.error("kv migration handshake refused for %s: %s",
-                             peer, mismatch)
+                             peer, sanitize_text(mismatch))
                 conn.send_frame(wire.FRAME_ERR, {
                     "rid": None, "code": "geometry", "error": mismatch})
                 return
@@ -399,6 +400,11 @@ class MigrationManager:
     def _fail(self, reason: str, msg: str, *, drain: bool = False) -> int:
         """Attribute one degraded migration attempt; always returns 0 so
         callers can ``return self._fail(...)``."""
+        # msg (and sometimes reason — callers pass the wire-frame "code"
+        # field through) carries peer-supplied bytes — sanitize before
+        # the log line and the /health last_error echo
+        reason = sanitize_text(reason, limit=64)
+        msg = sanitize_text(msg)
         with self._lock:
             self.counters["drain_failures" if drain else "failures"] += 1
             self.last_error = f"{reason}: {msg}"
@@ -451,7 +457,7 @@ class MigrationManager:
         finally:
             conn.close()
 
-    def _resolve_wire(self, http_addr: str, budget: float) -> str | None:
+    def _resolve_wire(self, http_addr: str, budget: float) -> str | None:  # lfkt: sanitizes[peer-http] -- http_addr comes from the admitted PeerTable (or the router's commanded drain), so the /health doc it fetches is as trusted as the peer set itself; the addr:port shape check below bounds what a misbehaving peer can redirect a pull to
         """A peer's page-service wire addr, via its /health ``migration``
         block (cached; ephemeral ports make this discovery, not config)."""
         with self._lock:

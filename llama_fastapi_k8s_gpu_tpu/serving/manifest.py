@@ -61,10 +61,27 @@ class ModelSpec:
 
     def resolved_path(self, model_dir: str) -> str:
         """Absolute-or-relative resolution against the model dir (the
-        single-model ``LFKT_MODEL_DIR``/``LFKT_MODEL_NAME`` convention)."""
+        single-model ``LFKT_MODEL_DIR``/``LFKT_MODEL_NAME`` convention).
+
+        Relative paths must stay UNDER the model dir after symlink/..
+        resolution: manifests arrive over the network via ``POST
+        /admin/models/reload``, so an unconstrained join would let a
+        ``../../`` entry read any file the pod can (lfkt-lint TAINT002
+        pins this containment check).  Absolute paths remain the
+        explicit operator escape hatch — they name the file outright
+        rather than smuggling a traversal through the join."""
         if os.path.isabs(self.path):
             return self.path
-        return os.path.join(model_dir, self.path)
+        joined = os.path.join(model_dir, self.path)
+        base = os.path.realpath(model_dir)
+        real = os.path.realpath(joined)
+        if real != base and not real.startswith(base + os.sep):
+            raise ValueError(
+                f"model {self.name!r}: path {self.path!r} escapes the "
+                f"model dir {model_dir!r} after resolution — relative "
+                "manifest paths must stay under LFKT_MODEL_DIR "
+                "(docs/MULTIMODEL.md)")
+        return joined
 
 
 def parse_manifest(spec: str) -> list[ModelSpec]:

@@ -40,6 +40,7 @@ import threading
 import time
 
 from ..obs import memledger as _memledger
+from ..obs.logctx import sanitize_text
 from .manifest import ModelSpec, parse_manifest, pick_default
 
 logger = logging.getLogger(__name__)
@@ -233,11 +234,13 @@ class ModelRegistry:
             info.append(row)
             if shared_pool is None:
                 shared_pool = getattr(eng, "_kvpool", None)
-        logger.info(
+        logger.info(  # lfkt: sanitizes[manifest] -- used is an integer byte counter (getsize/_describe sums); the only manifest string here is default_model, sanitized below
             "model registry: %d models, %.0fMB weights%s (default=%s)",
             len(engines), used / 1e6,
             f" of {weight_budget_bytes / 1e6:.0f}MB budget"
-            if weight_budget_bytes else "", default_model)
+            if weight_budget_bytes else "",
+            # the name may come from a POSTed reload manifest
+            sanitize_text(default_model, limit=128))
         reg = cls(engines, default_model, model_info=info)
         # live-reload plumbing (reload_manifest): the SAME builder +
         # budget the startup load used, so a reloaded model is shaped

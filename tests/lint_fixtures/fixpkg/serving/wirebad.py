@@ -1,0 +1,52 @@
+"""Planted WIRE001/WIRE002 violations + clean twins (lfkt-lint v4).
+
+BadProxy.handle is the PR-17 regression pin: GoodProxy's forward loop
+with the internal-stamp strip REMOVED — the declared ingress can then
+forward a client's forged ``x-lfkt-fix-stamp`` upstream, and the CFG
+must-analysis (WIRE002) catches it.  The undeclared_* functions plant
+the three WIRE001 shapes: a header literal, a frame-ctor dict key and
+a ``hdr.get`` field read the registry does not know.  See
+../../README.md.
+"""
+
+STAMP = "x-lfkt-fix-stamp"
+
+
+class GoodProxy:
+    """Strips the internal stamp before forwarding — must stay clean."""
+
+    def _forward_bytes(self, head):
+        return head
+
+    def handle(self, raw_headers):
+        base = []
+        for line in raw_headers:
+            if line in (STAMP,):          # fine: the strip (alias form)
+                continue
+            base.append(line)
+        return self._forward_bytes(base)
+
+
+class BadProxy:
+    """GoodProxy with the strip removed (WIRE002: forged stamp rides)."""
+
+    def _forward_bytes(self, head):
+        return head
+
+    def handle(self, raw_headers):
+        base = []
+        for line in raw_headers:
+            base.append(line)
+        return self._forward_bytes(base)  # WIRE002: stamp never stripped
+
+
+def undeclared_header():
+    return {"x-lfkt-not-declared": "1"}   # WIRE001: undeclared header
+
+
+def undeclared_field(conn):
+    conn.send_frame(1, {"rid": None, "bogus": 2})   # WIRE001: 'bogus'
+
+
+def undeclared_read(hdr):
+    return hdr.get("phantom")             # WIRE001: undeclared field read

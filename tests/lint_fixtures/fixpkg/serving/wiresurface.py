@@ -1,0 +1,41 @@
+"""Fixture wire-surface registry (lfkt-lint v4 self-tests).
+
+lint/wire.py parses these declarations statically — the presence of
+this file is what arms the WIRE rules for fixpkg.  The two ingress
+rows point at serving/wirebad.py: the GoodProxy twin strips the
+internal stamp (must stay clean), the BadProxy twin is the PR-17
+regression shape with the strip removed (must fire WIRE002).  There is
+deliberately no FIXTURES/docs/WIRESURFACE.md, so WIRE003 fires here
+too (the drift pin).  See ../../README.md.
+"""
+
+
+def WireHeader(*args):
+    return args
+
+
+def WireField(*args):
+    return args
+
+
+def WireIngress(*args):
+    return args
+
+
+HEADERS = (
+    WireHeader("x-lfkt-fix-pin", "inbound", "client-settable",
+               "fixture client-settable header"),
+    WireHeader("x-lfkt-fix-stamp", "internal", "internal-stamped-must-strip",
+               "fixture internal stamp; every ingress must strip it"),
+)
+
+FIELDS = (
+    WireField("rid", "REQ", "peer-only", "fixture frame field"),
+)
+
+INGRESSES = (
+    WireIngress("serving.wirebad:GoodProxy.handle", "_forward_bytes",
+                "fixture ingress WITH the strip (clean twin)"),
+    WireIngress("serving.wirebad:BadProxy.handle", "_forward_bytes",
+                "fixture ingress WITHOUT the strip (WIRE002 pin)"),
+)

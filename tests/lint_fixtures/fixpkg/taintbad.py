@@ -1,0 +1,138 @@
+"""Planted TAINT001/002/003 violations + clean twins (lfkt-lint v4).
+
+Every leak here is load-bearing for tests/test_lint.py: sources
+(recv_frame, .headers, getresponse, ModelSpec.path) flowing into addr /
+header / path / argv / log sinks, the interprocedural two-hop shape,
+and the CLEAN twins of every sanctioned declassification — the
+allowlist guard, the realpath containment guard, the registered
+sanitizer, the def-line `sanitizes[...]` validator and the line-level
+audit.  See ../README.md.
+"""
+
+import logging
+import os
+import socket
+import subprocess
+
+logger = logging.getLogger(__name__)
+
+
+class ModelSpec:
+    """Fixture twin of serving.manifest.ModelSpec (TAINTED_ATTRS)."""
+
+    path = "models/fix.gguf"
+
+
+def sanitize_text(value, limit=512):
+    """Fixture twin of obs.logctx.sanitize_text (registered sanitizer)."""
+    return str(value)[:limit]
+
+
+# -- the leaks ---------------------------------------------------------------
+
+def leak_addr(conn):
+    frame = conn.recv_frame()
+    addr = str(frame.get("prior_owner"))
+    return socket.create_connection((addr, 9000))    # TAINT001: addr sink
+
+
+def leak_header(reader, writer):
+    line = reader.readline()
+    writer.write(f"x-echo: {line}\r\n".encode())     # TAINT001: CR/LF join
+
+
+def _read_target(conn):
+    frame = conn.recv_frame()
+    return str(frame.get("pull_from"))
+
+
+def _dial(addr):
+    return socket.create_connection((addr, 9000))    # TAINT001: two-hop
+
+
+def leak_interproc(conn):
+    # the v4 point: source in _read_target, sink in _dial — only the
+    # summary fixpoint connects them
+    return _dial(_read_target(conn))
+
+
+def leak_path(req):
+    name = req.headers.get("x-model")
+    return open(os.path.join("models", name))        # TAINT002: path sink
+
+
+def leak_argv(req):
+    tool = req.headers.get("x-tool")
+    subprocess.run([tool, "--version"])              # TAINT002: argv sink
+
+
+def leak_manifest(spec: ModelSpec):
+    os.remove(spec.path)                             # TAINT002: manifest
+
+
+def leak_log(conn):
+    frame = conn.recv_frame()
+    logger.warning("peer refused: %s", frame.get("error"))   # TAINT003
+
+
+def leak_peer_doc(client):
+    resp = client.getresponse()
+    logger.info("health doc: %s", resp.read())       # TAINT003: peer-http
+
+
+# -- the clean twins ---------------------------------------------------------
+
+def clean_addr(conn, peers):
+    frame = conn.recv_frame()
+    addr = str(frame.get("prior_owner"))
+    if addr not in peers:         # fine: allowlist guard declassifies addr
+        return None
+    return socket.create_connection((addr, 9000))
+
+
+def clean_path(req):
+    name = req.headers.get("x-model")
+    joined = os.path.join("models", name)
+    real = os.path.realpath(joined)
+    base = os.path.realpath("models")
+    if not real.startswith(base + os.sep):  # fine: containment guard
+        raise ValueError("path escapes the model dir")
+    return open(joined)
+
+
+def clean_log(conn):
+    frame = conn.recv_frame()
+    msg = sanitize_text(frame.get("error"))
+    logger.warning("peer refused: %s", msg)   # fine: sanitized upstream
+
+
+def read_owner(conn):  # lfkt: sanitizes[wire-frame] -- fixture: validator twin; shape-checks the owner before anyone trusts it
+    frame = conn.recv_frame()
+    return str(frame.get("owner"))
+
+
+def clean_via_validator(conn):
+    addr = read_owner(conn)
+    return socket.create_connection((addr, 9000))   # fine: validator output
+
+
+def audited_line(conn):
+    frame = conn.recv_frame()
+    logger.info("hello: %s", frame.get("v"))  # lfkt: sanitizes[wire-frame] -- fixture: line-level audit covers this one site
+
+
+# -- the suppression / audit grammar -----------------------------------------
+
+def suppressed_log(conn):
+    frame = conn.recv_frame()
+    logger.info("frame: %s", frame.get("v"))  # lfkt: noqa[TAINT003] -- fixture: proves TAINT suppression works
+
+
+def reasonless_audit(conn):
+    frame = conn.recv_frame()
+    logger.info("x: %s", frame.get("v"))  # lfkt: sanitizes[wire-frame]
+
+
+def unknown_tag(conn):
+    frame = conn.recv_frame()
+    logger.info("y: %s", frame.get("v"))  # lfkt: sanitizes[telepathy] -- fixture: unknown source tag

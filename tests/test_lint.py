@@ -24,6 +24,7 @@ import os
 import re
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -38,14 +39,17 @@ FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
 # ---------------------------------------------------------------------------
 
 _tree_findings_cache: list | None = None
+_tree_findings_seconds: float | None = None
 
 
 def _tree_findings():
-    global _tree_findings_cache
+    global _tree_findings_cache, _tree_findings_seconds
     if _tree_findings_cache is None:
+        t0 = time.monotonic()
         _tree_findings_cache = run_lint(
             package_dir=os.path.join(REPO, "llama_fastapi_k8s_gpu_tpu"),
             repo_root=REPO)
+        _tree_findings_seconds = time.monotonic() - t0
     return _tree_findings_cache
 
 
@@ -130,6 +134,15 @@ def _fired(rule, path_part, suppressed=False):
     ("LINT000", "blockunderbad.py", 1),  # blocks-under[] without reason
     ("LINT001", "noqabad.py", 2),   # unknown rule id + empty rule list
     ("LINT001", "blockunderbad.py", 1),  # blocks-under unknown lock
+    ("TAINT001", "taintbad.py", 3),  # addr sink + CR/LF f-string + two-hop
+    ("TAINT002", "taintbad.py", 3),  # path sink + argv + ModelSpec.path
+    ("TAINT003", "taintbad.py", 3),  # frame log + peer-http log +
+                                     # unknown-tag audit doesn't discharge
+    ("WIRE001", "wirebad.py", 3),    # literal + frame-ctor key + hdr.get
+    ("WIRE002", "wirebad.py", 1),    # BadProxy: the strip-removed twin
+    ("WIRE003", "serving/wiresurface.py", 1),  # no fixture docs table
+    ("LINT000", "taintbad.py", 1),   # sanitizes[] without reason
+    ("LINT001", "taintbad.py", 1),   # sanitizes[] unknown source tag
 ])
 def test_rule_fires_on_fixture(rule, path_part, min_hits):
     hits = _fired(rule, path_part)
@@ -162,6 +175,7 @@ def test_host_only_code_not_flagged_by_jit_rules():
     ("RES001", "resbad.py"),        # suppressed_leak's audited noqa
     ("DON001", "donbad.py"),        # suppressed_read's audited noqa
     ("DEAD001", "deadbad.py"),      # registry_hook getattr exemption
+    ("TAINT003", "taintbad.py"),    # suppressed_log's audited noqa
 ])
 def test_noqa_suppresses(rule, path_part):
     sup = _fired(rule, path_part, suppressed=True)
@@ -286,6 +300,91 @@ def test_concurrency_clean_twins_silent():
         assert not near, (marker, [f.render() for f in near])
 
 
+def test_taint_clean_twins_silent():
+    """The sanctioned declassifications — allowlist guard, realpath
+    containment guard, the registered sanitizer, the def-line
+    `sanitizes[...]` validator, the line-level audit — must produce no
+    unsuppressed TAINT findings on their fixture twins."""
+    taint = [f for f in _fix_findings()
+             if f.rule.startswith("TAINT") and "taintbad.py" in f.path
+             and not f.suppressed]
+    lines = {f.line for f in taint}
+    for marker in ("fine: allowlist guard", "fine: containment guard",
+                   "fine: sanitized upstream", "fine: validator output",
+                   "fixture: line-level audit"):
+        ln = _fixture_line("taintbad.py", marker)
+        span = set(range(ln - 1, ln + 4))   # the sink sits at/below it
+        assert not lines & span, (marker, [f.render() for f in taint])
+
+
+def test_wire_strip_twin_clean():
+    """GoodProxy (the ingress WITH the strip, via the module-level alias
+    and a loop-anchored membership test) must pass the WIRE002
+    must-analysis; BadProxy is asserted to fire in the parametrized
+    table."""
+    wire2 = [f for f in _fix_findings() if f.rule == "WIRE002"]
+    good_span = range(_fixture_line("serving/wirebad.py",
+                                    "class GoodProxy"),
+                      _fixture_line("serving/wirebad.py",
+                                    "class BadProxy"))
+    assert not [f for f in wire2 if f.line in good_span], (
+        [f.render() for f in wire2])
+
+
+def _copy_pkg(tmp_path):
+    import shutil
+
+    pkg = tmp_path / "llama_fastapi_k8s_gpu_tpu"
+    shutil.copytree(os.path.join(REPO, "llama_fastapi_k8s_gpu_tpu"),
+                    pkg, ignore=shutil.ignore_patterns("__pycache__"))
+    return pkg
+
+
+def test_pr17_strip_removal_fires_wire002(tmp_path):
+    """ISSUE 18 acceptance pin: deleting the fleet router's inbound
+    stamp strip (the PR-17 hand-fix) must fire WIRE002 on the real
+    router — the declared ingress can then forward a client's forged
+    x-lfkt-affinity-key / x-lfkt-prior-owner upstream."""
+    pkg = _copy_pkg(tmp_path)
+    router = pkg / "serving" / "fleet" / "router.py"
+    src = router.read_text()
+    strip = ('_HOP_HEADERS + (b"content-length", b"host",\n'
+             '                                        '
+             'AFFINITY_KEY_HEADER.encode(),\n'
+             '                                        '
+             'PRIOR_OWNER_HEADER.encode())')
+    assert strip in src, "router strip shape moved; update this pin"
+    router.write_text(src.replace(
+        strip, '_HOP_HEADERS + (b"content-length", b"host")'))
+    findings = run_lint(package_dir=str(pkg), rules={"WIRE002"})
+    hits = [f for f in findings
+            if f.rule == "WIRE002" and "router.py" in f.path
+            and not f.suppressed]
+    assert len(hits) >= 2, [f.render() for f in findings]  # both stamps
+    # and the unedited tree is clean (asserted via the cached full run)
+    assert not [f for f in _tree_findings()
+                if f.rule == "WIRE002" and not f.suppressed]
+
+
+def test_manifest_containment_removal_fires_taint002(tmp_path):
+    """ISSUE 18 acceptance pin: disabling ModelSpec.resolved_path's
+    realpath containment guard must fire TAINT002 — a POSTed manifest
+    path could then escape LFKT_MODEL_DIR."""
+    pkg = _copy_pkg(tmp_path)
+    manifest = pkg / "serving" / "manifest.py"
+    src = manifest.read_text()
+    guard = "if real != base and not real.startswith(base + os.sep):"
+    assert guard in src, "containment guard moved; update this pin"
+    manifest.write_text(src.replace(guard, "if False:"))
+    findings = run_lint(package_dir=str(pkg), rules={"TAINT002"})
+    hits = [f for f in findings
+            if f.rule == "TAINT002" and "manifest.py" in f.path
+            and not f.suppressed]
+    assert hits, [f.render() for f in findings]
+    assert not [f for f in _tree_findings()
+                if f.rule == "TAINT002" and not f.suppressed]
+
+
 def test_changed_mode_equals_full_run(tmp_path):
     """Satellite (ISSUE 15): ``--changed`` must produce the IDENTICAL
     finding set to a full run — on a cold cache, on a warm no-op cache
@@ -357,15 +456,15 @@ def test_lint_runtime_budget():
     """Satellite (ISSUE 15): the full-package lint pass — the
     interprocedural concurrency families included — must finish under a
     fixed wall bound on CPU, so whole-package analysis can never quietly
-    make the tier-1 suite unusable.  The bound is ~10x the current cost;
-    tighten it if the suite ever gets a faster floor."""
-    import time as _time
-
-    t0 = _time.monotonic()
-    run_lint(package_dir=os.path.join(REPO, "llama_fastapi_k8s_gpu_tpu"),
-             repo_root=REPO)
-    wall = _time.monotonic() - t0
-    assert wall < 60.0, f"full lint pass took {wall:.1f}s (budget 60s)"
+    make the tier-1 suite unusable.  The bound is ~7x the current cost;
+    tighten it if the suite ever gets a faster floor.  Timed on the
+    shared full-tree pass (the one the layer-1 tests consume) rather
+    than a second derivation — same pass, same machine, half the
+    suite cost."""
+    _tree_findings()
+    assert _tree_findings_seconds is not None
+    assert _tree_findings_seconds < 60.0, \
+        f"full lint pass took {_tree_findings_seconds:.1f}s (budget 60s)"
 
 
 def test_concurrency_baseline_ratchet_is_empty_and_green():
@@ -385,6 +484,42 @@ def test_concurrency_baseline_ratchet_is_empty_and_green():
         cwd=REPO, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "ratchet OK" in proc.stdout
+
+
+def test_taint_baseline_ratchet_is_empty_and_green():
+    """The committed trust-boundary baseline is EMPTY (every in-tree
+    flow is sanitized, guard-declassified, or reason-audited — nothing
+    grandfathered), and the ci_gate lint-taint check passes against it."""
+    import json
+
+    doc = json.load(open(os.path.join(REPO, "lint_baseline_taint.json")))
+    assert doc["schema"] == 1 and doc["findings"] == []
+    proc = subprocess.run(
+        [sys.executable, "tools/lint_report.py",
+         "--baseline", "lint_baseline_taint.json",
+         "--rules", "TAINT001", "TAINT002", "TAINT003",
+         "WIRE001", "WIRE002", "WIRE003"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ratchet OK" in proc.stdout
+
+
+def test_wiresurface_docs_pinned_to_runtime_table():
+    """docs/WIRESURFACE.md's generated block is byte-identical to the
+    runtime markdown_table() — closing the loop WIRE003 leaves open
+    (WIRE003 compares the docs against lint/wire.py's STATIC re-render;
+    this pins static == runtime == docs)."""
+    from llama_fastapi_k8s_gpu_tpu.serving.wiresurface import (
+        internal_stamped_headers, markdown_table)
+
+    assert internal_stamped_headers() == (
+        "x-lfkt-affinity-key", "x-lfkt-prior-owner")
+    begin = "<!-- wire-surface:begin (generated - do not hand-edit) -->"
+    end = "<!-- wire-surface:end -->"
+    text = open(os.path.join(REPO, "docs", "WIRESURFACE.md")).read()
+    lo = text.index(begin) + len(begin)
+    hi = text.index(end)
+    assert text[lo:hi].strip("\n") == markdown_table()
 
 
 # ---------------------------------------------------------------------------
@@ -522,21 +657,53 @@ def test_lint_report_baseline_ratchet(tmp_path):
 
 def test_ci_gate_aggregates_lint_and_manifest():
     """tools/ci_gate.py (POST_SUITE_CHECKLIST step 1): one entry point,
-    both repo gates, --json machine shape, exit 0 on a clean tree."""
+    both repo gates, --json machine shape, exit 0 on a clean tree.
+
+    The three pytest-subset checks are --skip'd here: they re-spawn
+    tests (decode_loop serial_parity, fleet route_parity, chaos smoke)
+    that THIS tier-1 session already ran first-class, and the duplicate
+    subprocess runs cost ~35s of suite wall for zero added coverage.
+    Their argv targets are asserted below so the check definitions
+    cannot rot; standalone `python tools/ci_gate.py` still runs them.
+    lfkt-lint and the two ratchets are --skip'd for the same reason:
+    the identical commands are test_cli_exits_zero_on_tree and the two
+    *_baseline_ratchet_is_empty_and_green tests, a few tests up."""
     import json
 
+    pytest_checks = {"decode-loop-parity", "fleet-route-parity",
+                     "chaos-drill"}
+    dup_checks = {"lfkt-lint", "lint-concurrency", "lint-taint"}
     proc = subprocess.run(
-        [sys.executable, "tools/ci_gate.py", "--json"],
+        [sys.executable, "tools/ci_gate.py", "--json",
+         "--skip", ",".join(sorted(pytest_checks | dup_checks))],
         cwd=REPO, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout)
     assert doc["ok"] is True
     names = {c["name"] for c in doc["checks"]}
-    assert names == {"lfkt-lint", "lint-concurrency", "check-manifest",
-                     "incident-schema", "disagg-wire-schema",
-                     "decode-loop-parity", "fleet-route-parity",
-                     "chaos-drill"}
+    assert names == {"lfkt-lint", "lint-concurrency", "lint-taint",
+                     "check-manifest", "incident-schema",
+                     "disagg-wire-schema", "decode-loop-parity",
+                     "fleet-route-parity", "chaos-drill"}
     assert all(c["exit"] == 0 for c in doc["checks"])
+    assert {c["name"] for c in doc["checks"]
+            if c.get("skipped")} == pytest_checks | dup_checks
+    # the skipped checks' test files + -k markers must not rot: the file
+    # exists and the marker matches a test name in it (the substance of
+    # each check runs natively in this very tier-1 session)
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_ci_gate", os.path.join(REPO, "tools", "ci_gate.py"))
+    ci_gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ci_gate)
+    for name, argv in ci_gate.CHECKS:
+        if name in pytest_checks:
+            test_file = next(a for a in argv if a.endswith(".py"))
+            marker = argv[argv.index("-k") + 1]
+            assert os.path.exists(test_file), f"{name}: {test_file}"
+            src = open(test_file, encoding="utf-8").read()
+            assert re.search(rf"def test_\w*{re.escape(marker)}", src), \
+                f"{name}: -k {marker!r} matches no test in {test_file}"
 
 
 def test_cli_lists_every_rule():

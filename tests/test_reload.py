@@ -379,6 +379,49 @@ async def test_admin_reload_route_roundtrip(ggufs):
     reg.shutdown()
 
 
+def test_resolved_path_contains_relative_paths(tmp_path):
+    """ModelSpec.resolved_path: relative manifest paths must stay under
+    the model dir after symlink/..-resolution; absolute paths are the
+    operator's explicit choice and pass through."""
+    from llama_fastapi_k8s_gpu_tpu.serving.manifest import ModelSpec
+
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "ok.gguf").write_bytes(b"x")
+    assert ModelSpec("ok", "ok.gguf").resolved_path(str(d)) == str(
+        d / "ok.gguf")
+    assert ModelSpec("abs", str(d / "ok.gguf")).resolved_path(
+        "elsewhere") == str(d / "ok.gguf")
+    with pytest.raises(ValueError, match="escapes the"):
+        ModelSpec("evil", "../../etc/passwd").resolved_path(str(d))
+    with pytest.raises(ValueError, match="escapes the"):
+        ModelSpec("dot", "sub/../../outside.gguf").resolved_path(str(d))
+
+
+@pytest.mark.anyio
+async def test_admin_reload_rejects_path_traversal(ggufs):
+    """The fix's acceptance pin, through the REAL route: a POSTed
+    manifest whose relative path climbs out of the model dir gets a 400
+    naming the escape, and the running set is untouched."""
+    reg = _registry(ggufs)
+    app, transport = _client(reg)
+    async with transport:
+        await app.router.startup()
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://t",
+                                     timeout=300.0) as c:
+            r = await c.post("/admin/models/reload", json={
+                "models": (f"alpha={ggufs['a']},"
+                           "evil=../../../../etc/passwd")})
+            assert r.status_code == 400, r.text
+            assert "escapes the" in r.json()["detail"]
+            r = await c.get("/v1/models")
+            assert [m["id"] for m in r.json()["data"]] == ["alpha",
+                                                           "beta"]
+        await app.router.shutdown()
+    reg.shutdown()
+
+
 @pytest.mark.anyio
 async def test_admin_reload_refused_on_single_engine():
     app, transport = _client(FakeEngine())
