@@ -178,6 +178,11 @@ METRICS: dict[str, Metric] = _register(
            "router: one proxied request's wall (client head in -> "
            "backend response relayed)",
            buckets=LATENCY_BUCKETS),
+    Metric("fleet_probe_seconds", HISTOGRAM,
+           "router: one health-probe round trip per replica, success or "
+           "failure — the ejection-threshold tuning signal (a peer whose "
+           "probes crawl toward the timeout is about to be ejected)",
+           buckets=LATENCY_BUCKETS, labels=("peer",)),
     # -- fleet KV migration (serving/fleet/migrate.py) ---------------------
     Metric("kv_migration_pulls_total", COUNTER,
            "migration pulls attempted, by trigger (remap = router "
@@ -297,8 +302,10 @@ METRICS: dict[str, Metric] = _register(
     # -- SLO engine (obs/slo.py; docs/SLO.md) ------------------------------
     Metric("slo_burn_rate", GAUGE,
            "error-budget burn rate per SLO and window (1.0 = burning "
-           "exactly the budget; sustained >1 on every window = breach)",
-           labels=("slo", "window")),
+           "exactly the budget; sustained >1 on every window = breach); "
+           "scope=pod on replica scrapes, scope=fleet when the router "
+           "evaluates the catalog over federated histograms",
+           labels=("slo", "window", "scope")),
     # -- runtime-synthesized families --------------------------------------
     Metric("scheduler_", GAUGE,
            "continuous-scheduler occupancy family "

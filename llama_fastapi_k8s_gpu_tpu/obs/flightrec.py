@@ -58,9 +58,12 @@ logger = logging.getLogger(__name__)
 SCHEMA = 1
 
 #: the incident kinds the serving stack records (``disagg_peer_dead``:
-#: a decode replica's prefill peer died mid-stream — serving/disagg/)
+#: a decode replica's prefill peer died mid-stream — serving/disagg/;
+#: ``fleet_peer_ejected``: the router ejected a replica and pulled
+#: correlated bundle summaries from the involved peers —
+#: obs/fleettrace.py)
 KINDS = ("watchdog_trip", "dead_escalation", "resource_exhausted",
-         "slo_breach", "disagg_peer_dead")
+         "slo_breach", "disagg_peer_dead", "fleet_peer_ejected")
 
 #: bundle ids are process-minted and filesystem-safe; /debug/incidents/{id}
 #: refuses anything else (no path traversal through the id)
@@ -128,6 +131,7 @@ class FlightRecorder:
         self._log_handler: _LogRing | None = None
         self._health_ref = None      # weakref: utils/health.HealthMonitor
         self._engine_ref = None      # weakref: the serving engine/registry
+        self._fleet_fn = None        # zero-arg fleet-context provider
         self.armed = False
         self._dir = ""
         self._ring_size = 16
@@ -179,15 +183,24 @@ class FlightRecorder:
             # set LAST: record() keys off this single attribute
             self.armed = armed
 
-    def install(self, health=None, engine=None) -> None:
+    def install(self, health=None, engine=None, fleet=None) -> None:
         """Hand the recorder the process context it cannot import (the
         health monitor and the serving engine/registry) — weakly held, so
         a test's discarded app never pins its engine.  Called by the
-        server at startup; in-process tests call it directly."""
+        server at startup; in-process tests call it directly.
+
+        ``fleet`` is a zero-arg callable returning this process's fleet
+        context (role, peer identity, affinity-key digest, migration
+        attribution — whatever the caller can cheaply snapshot); every
+        bundle captures it under the ``fleet`` key so a bundle pulled
+        off any pod is attributable within the fleet without joining
+        logs by hand."""
         import weakref
 
         if health is not None:
             self._health_ref = weakref.ref(health)
+        if fleet is not None:
+            self._fleet_fn = fleet
         if engine is not None:
             try:
                 self._engine_ref = weakref.ref(engine)
@@ -255,6 +268,12 @@ class FlightRecorder:
                     health = h.snapshot()
                 except Exception:  # noqa: BLE001 — partial bundles beat none
                     pass
+        fleet = None
+        if self._fleet_fn is not None:
+            try:
+                fleet = self._fleet_fn()
+            except Exception:  # noqa: BLE001 — partial bundles beat none
+                pass
         scheduler = None
         if self._engine_ref is not None:
             eng = self._engine_ref()
@@ -274,6 +293,7 @@ class FlightRecorder:
             "traces": all_inflight_trees(),
             "scheduler": scheduler,
             "health": health,
+            "fleet": fleet,
             "recompile": {"storms": DEVTIME.storms(),
                           "storms_total": DEVTIME.storms_total},
             "log_tail": list(self._log_ring or ()),
@@ -382,7 +402,7 @@ def validate_bundle(doc) -> list[str]:
                        ("extra", dict)):
         if not isinstance(doc.get(field), typ):
             bad.append(f"missing {typ.__name__} '{field}'")
-    for field in ("scheduler", "health"):
+    for field in ("scheduler", "health", "fleet"):
         if doc.get(field) is not None and not isinstance(doc[field], dict):
             bad.append(f"'{field}' must be an object or null")
     return bad

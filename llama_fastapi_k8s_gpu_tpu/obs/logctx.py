@@ -96,8 +96,12 @@ class JsonFormatter(logging.Formatter):
         }
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
-        # structured extras attached via logger.*(..., extra={...})
-        for key in ("route", "method", "status", "duration_s"):
+        # structured extras attached via logger.*(..., extra={...});
+        # peer/spills/attempt are the fleet router's access fields — one
+        # record per proxy attempt, joinable with replica access lines
+        # through the shared request id
+        for key in ("route", "method", "status", "duration_s",
+                    "peer", "spills", "attempt"):
             v = record.__dict__.get(key)
             if v is not None:
                 out[key] = v

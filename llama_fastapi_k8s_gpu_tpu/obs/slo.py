@@ -27,7 +27,7 @@ Per-label families (``engine_ttft_seconds{bucket=...}``) are evaluated
 per series and report the WORST series' burn — a 32k-bucket TTFT
 violation must not hide under a healthy flood of short prompts.  The
 verdict document at ``/debug/slo`` carries every per-series number; the
-``slo_burn_rate{slo=,window=}`` gauges carry the worst.
+``slo_burn_rate{slo=,window=,scope=}`` gauges carry the worst.
 
 The verdict also folds in the devtime registry's recompile-storm state
 (obs/devtime.py): a program minting signatures past
@@ -139,10 +139,14 @@ class SLOEngine:
     _SHARED_ATOMIC = ("_breach_recorded",)
 
     def __init__(self, metrics, windows=None, thresholds: dict | None = None,
-                 devtime=None):
+                 devtime=None, scope: str = "pod"):
         from ..utils.config import knob
 
         self._metrics = metrics
+        #: rides the slo_burn_rate gauge: "pod" for a replica evaluating
+        #: its own registry, "fleet" when the router evaluates the
+        #: catalog over federated histograms (obs/fleettrace.py)
+        self.scope = str(scope)
         self._devtime = devtime if devtime is not None else DEVTIME
         if windows is None:
             raw = str(knob("LFKT_SLO_WINDOWS"))
@@ -342,12 +346,13 @@ class SLOEngine:
         return doc
 
     def export(self, now: float | None = None) -> dict:
-        """Evaluate and publish ``slo_burn_rate{slo,window}`` gauges into
-        the bound metrics registry (the /metrics scrape hook).  Returns
-        the verdict document so callers can reuse it."""
+        """Evaluate and publish ``slo_burn_rate{slo,window,scope}``
+        gauges into the bound metrics registry (the /metrics scrape
+        hook).  Returns the verdict document so callers can reuse it."""
         doc = self.evaluate(now=now)
         for s in doc["slos"]:
             for wl, ev in s["windows"].items():
                 self._metrics.set_gauge("slo_burn_rate", ev["burn_rate"],
-                                        slo=s["name"], window=wl)
+                                        slo=s["name"], window=wl,
+                                        scope=self.scope)
         return doc

@@ -70,6 +70,22 @@ def parse_traceparent(value: str | None) -> tuple[str, str] | None:
     return trace_id, span_id
 
 
+def span_traceparent(span: "Span | Trace | None") -> str | None:
+    """The W3C ``traceparent`` naming ``span`` as the parent — the stamp
+    an outbound hop (disagg REQ, migration REQ, router proxy attempt)
+    carries so the serving side's span tree links under this exact node.
+    None-tolerant: sampled-out callers pass their ``span=None`` straight
+    through and the wire field rides as null (zero-cost contract).
+    Accepts a :class:`Trace` too (some producers hand the whole trace
+    around rather than a span — app.py's migrate hook does)."""
+    if span is None:
+        return None
+    if isinstance(span, Trace):
+        return span.traceparent()
+    return (f"{_TRACEPARENT_VERSION}-{span._trace.trace_id}"
+            f"-{span.span_id}-01")
+
+
 class Span:
     """One timed phase of a request.  Built by :meth:`Trace.span` /
     :meth:`Span.child`; closed with :meth:`end` (idempotent)."""
@@ -277,6 +293,23 @@ class Tracer:
                         (self._count - 1) * self.sample):
                     self.sampled_out_total += 1
                     return None
+            tr = Trace(name, traceparent=traceparent, t0=t0)
+            self.started_total += 1
+            self._inflight[tr.trace_id] = tr
+        return tr
+
+    def start_linked(self, name: str,
+                     traceparent: str | None,
+                     t0: float | None = None) -> Trace | None:
+        """Begin a SERVER-SIDE trace fragment under a remote parent, or
+        None.  Unlike :meth:`start` this is parent-based sampling: the
+        client's decision propagates — we trace iff armed AND the wire
+        actually carried valid trace context.  Running the counter
+        sampler here would randomly orphan hops of requests the client
+        sampled in, which is worse than either extreme."""
+        if not self._armed or parse_traceparent(traceparent) is None:
+            return None
+        with self._lock:
             tr = Trace(name, traceparent=traceparent, t0=t0)
             self.started_total += 1
             self._inflight[tr.trace_id] = tr

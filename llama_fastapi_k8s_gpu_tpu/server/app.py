@@ -793,8 +793,21 @@ def create_app(engine=None, settings: Settings | None = None,
             engine.metrics_sink = app.state.metrics
         # hand the flight recorder the process context its bundles carry
         # (weakly held; obs/flightrec.py) — a later app wins, which is
-        # exactly the live serving app
-        _flightrec.FLIGHTREC.install(health=app.state.health, engine=engine)
+        # exactly the live serving app.  The fleet provider is read
+        # lazily at capture time so it sees the migration manager built
+        # a few lines below (and its last-served affinity-key digest —
+        # the attribution linking a replica's bundle to the conversation
+        # and peers involved in the incident).
+        def _replica_fleet_context(state=app.state):
+            out = {"role": "replica",
+                   "self": settings.migrate_self or None}
+            mig = getattr(state, "migration", None)
+            if mig is not None:
+                out["migration"] = mig.status()
+            return out
+
+        _flightrec.FLIGHTREC.install(health=app.state.health, engine=engine,
+                                     fleet=_replica_fleet_context)
         # disaggregated prefill/decode (serving/disagg/): arm the page
         # service and/or the remote-prefill client.  Misconfiguration
         # (no paged pool, registry, missing peer) refuses startup loudly

@@ -35,6 +35,7 @@ import numpy as np
 
 from ...obs import flightrec as _flightrec
 from ...obs.logctx import sanitize_text
+from ...obs.trace import span_traceparent
 from ...utils.health import DEGRADED, READY
 from . import wire
 from .transport import connect
@@ -150,19 +151,29 @@ class DisaggClient:
                 self._count("warm_local_skips")
                 return 0
             t0 = time.time()
+            fresh_dial = self._conn is None
             conn = self._ensure_conn(budget)  # lfkt: blocks-under[_hop_lock] -- hops serialize on one framed connection: the hop lock IS that serialization, and every wire op is budget-bounded
             if conn is None:
                 if self._refused is None:
                     self._fallback("peer_unreachable",
                                    self.last_error or "connect failed")
                 return 0
+            if span is not None and fresh_dial:
+                # the handshake's cost is part of THIS hop's story: a
+                # waterfall showing a slow first turn must name the dial
+                span.event("handshake", peer=self.peer,
+                           host_s=round(time.time() - t0, 6))
             try:
                 self._rid += 1
                 rid = self._rid
                 conn.settimeout(max(0.1, budget))
+                # wire schema 2: the REQ carries the caller's span context
+                # (None when sampled out) so the prefill tier's span tree
+                # links under the originating request's trace id
                 conn.send_frame(wire.FRAME_REQ, {  # lfkt: blocks-under[_hop_lock] -- hops serialize on one framed connection: the hop lock IS that serialization, and every wire op is budget-bounded
                     "rid": rid, "namespace": namespace,
-                    "ids": [int(t) for t in ids], "deadline": deadline})
+                    "ids": [int(t) for t in ids], "deadline": deadline,
+                    "trace": span_traceparent(span)})
                 groups: list[list] = []
                 got_pages = 0
                 bytes_in = 0

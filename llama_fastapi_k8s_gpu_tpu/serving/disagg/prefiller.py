@@ -28,6 +28,7 @@ import time
 
 from ...obs.logctx import sanitize_text
 from ...obs.memledger import register_component
+from ...obs.trace import TRACER
 from ...utils.faults import FAULTS, FaultError
 from . import wire
 from .transport import FrameConn, FrameSender
@@ -47,10 +48,10 @@ class PrefillServer:
     # written once at construction/stop (reference stores).
     _GUARDED_BY = {"_senders": "_lock", "counters": "_lock"}
     _THREAD_ENTRIES = ("_accept_loop", "_serve_conn")
-    _SHARED_ATOMIC = ("_stop", "_sock", "port", "metrics")
+    _SHARED_ATOMIC = ("_stop", "_sock", "port", "metrics", "_tracer")
 
     def __init__(self, engine, host: str = "0.0.0.0", port: int = 0,
-                 queue_frames: int = 32, metrics=None):
+                 queue_frames: int = 32, metrics=None, tracer=None):
         pool = getattr(engine, "_kvpool", None)
         if pool is None:
             raise ValueError(
@@ -63,6 +64,10 @@ class PrefillServer:
         self._geometry = wire.pool_geometry(pool)
         self._queue_frames = max(1, int(queue_frames))
         self.metrics = metrics
+        # the process tracer unless a test injects a private one; the
+        # REQ's ``trace`` field (wire schema 2) links this tier's span
+        # fragments under the originating request's id
+        self._tracer = tracer if tracer is not None else TRACER
         self._lock = threading.Lock()
         self._senders: dict[int, FrameSender] = {}
         self.counters = {"peers_total": 0, "prefills_served": 0,
@@ -179,16 +184,40 @@ class PrefillServer:
             conn.close()
 
     def _serve_request(self, sender: FrameSender, hdr: dict) -> None:
+        # server-side fragment of the originating request's trace: the
+        # REQ's ``trace`` field (wire schema 2) carries the decode side's
+        # span context.  start_linked returns None unless this process
+        # samples AND the field parsed — the untraced hot path pays two
+        # cheap guards, no lock, no allocation (zero-cost contract).
+        trace = self._tracer.start_linked("disagg.prefill",
+                                          hdr.get("trace"))
+        try:
+            self._serve_request_traced(sender, hdr, trace)
+        finally:
+            # None-tolerant; sweeps spans an error path left open
+            # (auto_closed) so a torn transfer still exports a fragment
+            self._tracer.finish(trace)
+
+    def _serve_request_traced(self, sender: FrameSender, hdr: dict,
+                              trace) -> None:
         rid = hdr.get("rid")
         ids = hdr.get("ids")
         ns = str(hdr.get("namespace") or "")
         deadline = hdr.get("deadline")
         if not isinstance(ids, list) or not ids \
                 or not all(isinstance(t, int) for t in ids):
+            if trace is not None:
+                trace.root.set(error="request: bad ids")
             sender.put(wire.FRAME_ERR, {
                 "rid": rid, "code": "request",
                 "error": "REQ ids must be a non-empty list of ints"})
             return
+        if trace is not None:
+            # rid/namespace are peer-supplied — sanitize before they
+            # ride the /debug/traces export and the waterfall renderer
+            trace.root.set(rid=sanitize_text(rid, limit=64),
+                           namespace=sanitize_text(ns, limit=64),
+                           tokens=len(ids))
 
         def put_timeout() -> float:
             # backpressure bound: a send queue still full past the
@@ -202,10 +231,13 @@ class PrefillServer:
             # PR-2 deadline propagation spans the hop: an expired request
             # must not occupy the prefill engine — the decode side has
             # already abandoned it and freed its pages
+            if trace is not None:
+                trace.root.set(error="deadline expired")
             sender.put(wire.FRAME_ERR, {
                 "rid": rid, "code": "deadline",
                 "error": "deadline expired before remote prefill"})
             return
+        sp = trace.span("engine.prefill") if trace is not None else None
         try:
             got = self.engine.prefill_to_pages(ids, namespace=ns,
                                                deadline=deadline)
@@ -213,16 +245,25 @@ class PrefillServer:
             # decode side degrades to local prefill with this attribution
             self._count("request_errors")
             logger.warning("disagg prefill request failed: %s", e)
+            if sp is not None:
+                sp.set(error=sanitize_text(
+                    f"{type(e).__name__}: {e}", limit=256)).end()
             sender.put(wire.FRAME_ERR, {
                 "rid": rid, "code": "prefill",
                 "error": f"{type(e).__name__}: {e}"})
             return
+        if sp is not None:
+            sp.end()
         if got is None:
             sender.put(wire.FRAME_DONE, {"rid": rid, "tokens": 0,
                                          "n_pages": 0, "first_token": None})
             return
         leaves, tokens, first_token = got
         n_pages = tokens // self._pool.page_tokens
+        # one span per wire transfer, one kv_pages event per PAGE group —
+        # the waterfall's ▓ bar covers exactly the bytes-on-the-wire time
+        sp_send = trace.span("wire.send") if trace is not None else None
+        sent_bytes = 0
         off = seq = 0
         while off < n_pages:
             # drill point: a prefill peer dying MID-STREAM (FaultError
@@ -239,11 +280,17 @@ class PrefillServer:
             self._count("bytes_sent", len(payload))
             self._emit("inc", "disagg_pages_sent_total", g)
             self._emit("inc", "disagg_bytes_sent_total", len(payload))
+            if sp_send is not None:
+                sent_bytes += len(payload)
+                sp_send.event("kv_pages", seq=seq, pages=g,
+                              bytes=len(payload))
             off += g
             seq += 1
         sender.put(wire.FRAME_DONE,
                    {"rid": rid, "tokens": tokens, "n_pages": n_pages,
                     "first_token": first_token}, timeout=put_timeout())
+        if sp_send is not None:
+            sp_send.set(pages=n_pages, bytes=sent_bytes).end()
         self._count("prefills_served")
         self._emit("inc", "disagg_prefills_served_total")
 

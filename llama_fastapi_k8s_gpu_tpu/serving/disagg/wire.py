@@ -46,7 +46,9 @@ import numpy as np
 
 #: bump on ANY change to the frame layout, header fields, or page payload
 #: encoding — a version mismatch refuses at the handshake with attribution
-WIRE_SCHEMA = 1
+#: (2: REQ carries an optional ``trace`` W3C traceparent so the serving
+#: side opens span trees linked to the originating request id)
+WIRE_SCHEMA = 2
 
 #: hard bound on one frame (length prefix sanity: a corrupt/hostile length
 #: must not allocate gigabytes before the JSON parse even runs)
@@ -221,7 +223,7 @@ def schema_descriptor() -> dict:
         "headers": {
             "HELLO": ["wire_schema", "page_tokens", "page_bytes", "leaves"],
             "HELLO_OK": ["wire_schema"],
-            "REQ": ["rid", "namespace", "ids", "deadline"],
+            "REQ": ["rid", "namespace", "ids", "deadline", "trace"],
             "PAGE": ["rid", "seq", "n_pages"],
             "DONE": ["rid", "tokens", "n_pages", "first_token"],
             "ERR": ["rid", "error", "code"],

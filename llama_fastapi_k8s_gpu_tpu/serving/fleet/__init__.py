@@ -33,11 +33,13 @@ from __future__ import annotations
 FLEET_ROLES = ("off", "router")
 
 
-def build_router(settings, metrics=None):
+def build_router(settings, metrics=None, tracer=None):
     """A ready-to-serve :class:`FleetRouter` from the fleet knobs, with
     the peer table probed once synchronously (the router never starts
     blind).  Misconfiguration refuses loudly — the LFKT_WORKERS idiom —
-    instead of routing into an empty fleet."""
+    instead of routing into an empty fleet.  ``tracer`` (an
+    obs.trace.Tracer; the process-wide one honours ``LFKT_TRACE_*``)
+    arms router-side span production and the fleet trace collector."""
     from .peers import PeerTable
     from .router import FleetRouter
 
@@ -55,7 +57,8 @@ def build_router(settings, metrics=None):
         proxy_timeout=settings.fleet_proxy_timeout_seconds,
         stream_timeout=settings.stream_deadline_seconds,
         max_spills=settings.fleet_max_spills,
-        fresh_seconds=settings.migrate_fresh_seconds)
+        fresh_seconds=settings.migrate_fresh_seconds,
+        tracer=tracer)
 
 
 def run_router(host: str, port: int) -> None:
@@ -64,9 +67,18 @@ def run_router(host: str, port: int) -> None:
     engine, no jax — the router is a placement process."""
     import asyncio
 
+    from ...obs.flightrec import FLIGHTREC
+    from ...obs.trace import TRACER
     from ...utils.config import get_settings
     from ...utils.metrics import Metrics
 
     settings = get_settings()
-    router = build_router(settings, metrics=Metrics())
+    # the process-wide tracer honours LFKT_TRACE_SAMPLE/LFKT_TRACE_RING
+    # (helm plumbs both onto the router pod); incident bundles recorded
+    # by the router carry its fleet identity
+    router = build_router(settings, metrics=Metrics(), tracer=TRACER)
+    FLIGHTREC.install(fleet=lambda: {
+        "role": "router",
+        "policy": router.policy,
+        "peers": router.peers.snapshot()})
     asyncio.run(router.serve(host, port))

@@ -55,6 +55,7 @@ churn".
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
 import logging
@@ -66,6 +67,7 @@ from collections import OrderedDict
 import numpy as np
 
 from ...obs.logctx import sanitize_text
+from ...obs.trace import TRACER, span_traceparent
 from ...utils.faults import FAULTS, FaultError
 from ..disagg import wire
 from ..disagg.transport import FrameConn, FrameSender, connect
@@ -101,14 +103,18 @@ class MigrationServer:
     # written once at construction/stop (reference stores).
     _GUARDED_BY = {"_senders": "_lock", "counters": "_lock"}
     _THREAD_ENTRIES = ("_accept_loop", "_serve_conn")
-    _SHARED_ATOMIC = ("_stop", "_sock", "port", "metrics")
+    _SHARED_ATOMIC = ("_stop", "_sock", "port", "metrics", "_tracer")
 
     def __init__(self, pool, host: str = "0.0.0.0", port: int = 0,
-                 queue_frames: int = 32, metrics=None):
+                 queue_frames: int = 32, metrics=None, tracer=None):
         self._pool = pool
         self._geometry = wire.pool_geometry(pool)
         self._queue_frames = max(1, int(queue_frames))
         self.metrics = metrics
+        # the process tracer unless a test injects a private one; the
+        # REQ's ``trace`` field (wire schema 2) links this pod's serve-
+        # side span fragments under the pulling request's trace id
+        self._tracer = tracer if tracer is not None else TRACER
         self._lock = threading.Lock()
         self._senders: dict[int, FrameSender] = {}
         self.counters = {"peers_total": 0, "pulls_served": 0,
@@ -213,16 +219,39 @@ class MigrationServer:
             conn.close()
 
     def _serve_request(self, sender: FrameSender, hdr: dict) -> None:
+        # server-side fragment of the pulling request's trace: the REQ's
+        # ``trace`` field (wire schema 2) carries the puller's span
+        # context.  start_linked returns None unless this process samples
+        # AND the field parsed — untraced pulls pay two cheap guards.
+        trace = self._tracer.start_linked("kv.migrate.serve",
+                                          hdr.get("trace"))
+        try:
+            self._serve_request_traced(sender, hdr, trace)
+        finally:
+            # None-tolerant; sweeps spans an error path left open
+            # (auto_closed) so a torn transfer still exports a fragment
+            self._tracer.finish(trace)
+
+    def _serve_request_traced(self, sender: FrameSender, hdr: dict,
+                              trace) -> None:
         rid = hdr.get("rid")
         ids = hdr.get("ids")
         ns = str(hdr.get("namespace") or "")
         deadline = hdr.get("deadline")
         if not isinstance(ids, list) or not ids \
                 or not all(isinstance(t, int) for t in ids):
+            if trace is not None:
+                trace.root.set(error="request: bad ids")
             sender.put(wire.FRAME_ERR, {
                 "rid": rid, "code": "request",
                 "error": "REQ ids must be a non-empty list of ints"})
             return
+        if trace is not None:
+            # rid/namespace are peer-supplied — sanitize before they
+            # ride the /debug/traces export and the waterfall renderer
+            trace.root.set(rid=sanitize_text(rid, limit=64),
+                           namespace=sanitize_text(ns, limit=64),
+                           tokens=len(ids))
 
         def put_timeout() -> float:
             # backpressure bound: a send queue still full past the pull's
@@ -245,10 +274,13 @@ class MigrationServer:
             # cold (or the pages were evicted between peek and pin): a
             # cheap honest miss — the puller recomputes locally
             self._count("pulls_cold")
+            if trace is not None:
+                trace.root.set(cold=True)
             sender.put(wire.FRAME_DONE, {"rid": rid, "tokens": 0,
                                          "n_pages": 0, "first_token": None},
                        timeout=put_timeout())
             return
+        sp = trace.span("pool.export") if trace is not None else None
         try:
             try:
                 leaves = pool.export_pages(lease)
@@ -261,12 +293,21 @@ class MigrationServer:
             # pulling side degrades to local recompute with this attribution
             self._count("request_errors")
             logger.warning("kv migration export failed: %s", e)
+            if sp is not None:
+                sp.set(error=sanitize_text(
+                    f"{type(e).__name__}: {e}", limit=256)).end()
             sender.put(wire.FRAME_ERR, {
                 "rid": rid, "code": "export",
                 "error": f"{type(e).__name__}: {e}"})
             return
+        if sp is not None:
+            sp.end()
         tokens = lease.tokens
         n_pages = tokens // pool.page_tokens
+        # one span per wire transfer, one kv_pages event per PAGE group —
+        # the waterfall's ▓ bar covers exactly the bytes-on-the-wire time
+        sp_send = trace.span("wire.send") if trace is not None else None
+        sent_bytes = 0
         off = seq = 0
         while off < n_pages:
             # drill point: the warm side dying MID-STREAM (FaultError
@@ -281,11 +322,17 @@ class MigrationServer:
                        payload, timeout=put_timeout())
             self._count("pages_sent", g)
             self._count("bytes_sent", len(payload))
+            if sp_send is not None:
+                sent_bytes += len(payload)
+                sp_send.event("kv_pages", seq=seq, pages=g,
+                              bytes=len(payload))
             off += g
             seq += 1
         sender.put(wire.FRAME_DONE,
                    {"rid": rid, "tokens": tokens, "n_pages": n_pages,
                     "first_token": None}, timeout=put_timeout())
+        if sp_send is not None:
+            sp_send.set(pages=n_pages, bytes=sent_bytes).end()
         self._count("pulls_served")
         self._emit("inc", "kv_migration_pushes_total")
         self._emit("inc", "kv_migration_pages_total", n_pages,
@@ -326,7 +373,8 @@ class MigrationManager:
     # counters.  Pull hops use a FRESH connection each (no shared conn
     # state), so no hop lock exists to rank against.
     _GUARDED_BY = {"_records": "_lock", "_wire_cache": "_lock",
-                   "counters": "_lock", "last_error": "_lock"}
+                   "counters": "_lock", "last_error": "_lock",
+                   "_last_key_digest": "_lock"}
     _SHARED_ATOMIC = ("metrics", "_closed")
 
     def __init__(self, pool, settings, metrics=None, health=None,
@@ -350,6 +398,10 @@ class MigrationManager:
                          "drain_pushes": 0, "drain_failures": 0,
                          "warmup_pulls": 0}
         self.last_error = None
+        #: digest of the most recent router-stamped affinity key — the
+        #: incident-bundle attribution linking a replica's capture to the
+        #: conversation it was serving (never the raw client-settable key)
+        self._last_key_digest = None
         self._closed = False
 
     # -- identity ----------------------------------------------------------
@@ -418,6 +470,7 @@ class MigrationManager:
         with self._lock:
             out = {"addr": self.wire_addr, "counters": dict(self.counters),
                    "records": len(self._records),
+                   "last_affinity_key": self._last_key_digest,
                    "last_error": self.last_error}
         if self.server is not None:
             out["service"] = self.server.status()
@@ -431,9 +484,15 @@ class MigrationManager:
         rendezvous-successor peers."""
         if not key or not ids:
             return
+        # digest, never the raw key: affinity keys can carry raw
+        # client-settable header bytes, and this value rides /health and
+        # the incident bundle's fleet block
+        digest = hashlib.sha256(str(key).encode(
+            "utf-8", "replace")).hexdigest()[:16]
         with self._lock:
             self._records.pop(key, None)
             self._records[key] = (str(namespace), tuple(ids))
+            self._last_key_digest = digest
             while len(self._records) > _RECORD_CAP:
                 self._records.popitem(last=False)
 
@@ -539,11 +598,15 @@ class MigrationManager:
                 return self._fail("protocol",
                                   f"{peer_wire}: expected HELLO_OK, got "
                                   f"{wire.FRAME_NAMES.get(ftype, ftype)}")
+            # wire schema 2: the REQ carries the caller's span context
+            # (None when sampled out) so the warm side's span tree links
+            # under the pulling request's trace id
             conn.send_frame(wire.FRAME_REQ, {
                 "rid": rid, "namespace": namespace,
                 "ids": [int(t) for t in ids[:target]],
                 "deadline": time.time() + max(0.1,
-                                              budget - (time.time() - t0))})
+                                              budget - (time.time() - t0)),
+                "trace": span_traceparent(span)})
             groups: list[list] = []
             got_pages = 0
             wire_bytes = 0

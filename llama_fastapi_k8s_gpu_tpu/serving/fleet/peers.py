@@ -67,6 +67,9 @@ class PeerTable:
     # -- lock discipline (lfkt-lint LOCK001-004) ---------------------------
     _GUARDED_BY = {"_peers": "_lock"}
     _THREAD_ENTRIES = ("_probe_loop",)
+    # on_eject is written once at router construction, read by the
+    # prober thread and the event loop — a single reference swap
+    _SHARED_ATOMIC = ("on_eject",)
 
     def __init__(self, peers: list[str] | None = None, dns: str = "",
                  probe_seconds: float = 2.0, backoff_seconds: float = 1.0,
@@ -83,6 +86,11 @@ class PeerTable:
         self._metrics = metrics
         self._stop = threading.Event()
         self._thread = None
+        #: optional rising-edge ejection hook ``(addr, reason)`` —
+        #: invoked OFF the table lock (it fans out; the router wires the
+        #: correlated incident pull here).  Must never raise-or-block by
+        #: contract; guarded anyway.
+        self.on_eject = None
         for addr in peers or []:
             addr = addr.strip()
             if addr:
@@ -179,6 +187,13 @@ class PeerTable:
             logger.warning("fleet: ejected replica %s (%s); re-probe in "
                            "%.1fs", addr, reason, p.backoff)
             self._emit("inc", "fleet_peer_ejections_total", peer=addr)
+            hook = self.on_eject
+            if hook is not None:
+                try:
+                    hook(addr, reason)
+                except Exception:  # noqa: BLE001 — an observability hook
+                    # must never turn an ejection into a router failure
+                    logger.exception("fleet: on_eject hook failed")
 
     def _readmit(self, addr: str) -> None:
         with self._lock:
@@ -267,7 +282,13 @@ class PeerTable:
             due = [p.addr for p in self._peers.values()
                    if p.healthy or now >= p.next_probe]
         for addr in due:
+            t0 = time.time()
             ok, err = self.probe(addr)
+            # success AND failure both observe: a peer whose probes
+            # crawl toward probe_timeout is about to be ejected, and the
+            # tuning signal must include the timeouts it already hit
+            self._emit("observe", "fleet_probe_seconds",
+                       time.time() - t0, peer=addr)
             if ok:
                 self._readmit(addr)
             else:

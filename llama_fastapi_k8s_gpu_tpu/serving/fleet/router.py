@@ -35,6 +35,29 @@ attributed ejection reasons), ``/health/ready`` (200 iff >= 1 healthy
 replica — k8s stops routing to a router whose whole fleet is down),
 ``/health/live`` and ``/metrics`` (the ``fleet_*`` families) itself;
 everything else is proxied.
+
+Fleet observability (lfkt-fleetobs; obs/fleettrace.py):
+
+- the router mints/ingests W3C ``traceparent`` and opens real spans per
+  proxy attempt (peer pick, spill/retry, response-head wait, stream
+  relay), stamping each outbound hop with the ATTEMPT span as parent —
+  so the replica's own trace fragment grafts under the exact attempt
+  that carried it.  Sampled out (``LFKT_TRACE_SAMPLE=0``) or
+  tracer-less, the relay path constructs no span at all and the inbound
+  ``traceparent`` passes through verbatim (zero-cost contract, pinned
+  by the poisoned-span test).
+- ``GET /debug/fleet/traces/{id}`` pulls that request id's fragments
+  from every healthy peer and returns ONE stitched multi-process tree.
+- ``GET /metrics/fleet`` federates peer scrapes (counters summed,
+  histograms merged bucket-wise, gauges re-labeled by peer) and
+  evaluates the SLO catalog over the MERGED distributions —
+  ``slo_burn_rate{scope="fleet"}`` rides the same body; ``GET
+  /debug/slo`` returns the fleet verdict document.
+- every proxy attempt writes one JSON access record (request id, chosen
+  peer, spill count) via obs/logctx.py, joinable with replica access
+  lines through the shared request id.
+- a peer ejection triggers a correlated incident pull
+  (``fleet_peer_ejected`` flight-recorder bundle).
 """
 
 from __future__ import annotations
@@ -42,13 +65,22 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import re
 import signal
 import time
+import uuid
 
 from .affinity import (AFFINITY_KEY_HEADER, PRIOR_OWNER_HEADER,
                        affinity_key, rendezvous_rank)
+from ...obs import fleettrace
+from ...obs.logctx import access_logger, bind_request_id, sanitize_text
+from ...obs.trace import parse_traceparent, span_traceparent
 
 logger = logging.getLogger(__name__)
+
+#: /debug/fleet/traces/{id}: ids are 32-hex by construction (obs/trace);
+#: anything else is refused before it can ride an outbound peer URL
+_TRACE_ID_RE = re.compile(r"[0-9a-f]{32}")
 
 #: response head elements the proxy rewrites rather than relays:
 #: connection signaling is hop-by-hop (RFC 9110 §7.6.1)
@@ -76,7 +108,8 @@ class FleetRouter:
                  proxy_timeout: float = 5.0,
                  stream_timeout: float = 300.0,
                  max_spills: int = 3,
-                 fresh_seconds: float = 600.0):
+                 fresh_seconds: float = 600.0,
+                 tracer=None):
         if policy not in ("affinity", "roundrobin"):
             raise ValueError(
                 f"LFKT_FLEET_POLICY must be affinity|roundrobin, "
@@ -84,6 +117,9 @@ class FleetRouter:
         self.peers = peers
         self.policy = policy
         self.metrics = metrics
+        #: obs.trace.Tracer (None = no router-side tracing at all; the
+        #: inbound traceparent still relays verbatim)
+        self.tracer = tracer
         self.proxy_timeout = proxy_timeout
         self.stream_timeout = stream_timeout
         self.max_spills = max(0, int(max_spills))
@@ -96,6 +132,20 @@ class FleetRouter:
             "proxied": 0, "spills": 0, "mid_stream_aborts": 0,
             "no_replica_503s": 0, "budget_503s": 0,
         }
+        #: federated SLO state (GET /metrics/fleet, GET /debug/slo):
+        #: the UNMODIFIED engine evaluates the catalog over the latest
+        #: bucket-wise merge of peer scrapes (obs/fleettrace.py)
+        from ...obs.slo import SLOEngine
+
+        self._fleet_view = fleettrace.FleetMetricsView()
+        self._fleet_slo = SLOEngine(self._fleet_view, scope="fleet")
+        # correlated incident capture on ejections (prober-side ones
+        # included); no-op while the local flight recorder is disarmed
+        if hasattr(peers, "on_eject"):
+            peers.on_eject = self._on_peer_eject
+
+    def _on_peer_eject(self, addr: str, reason: str) -> None:
+        fleettrace.incident_pull_async(addr, self.peers.healthy(), reason)
 
     # -- telemetry ---------------------------------------------------------
     def _emit(self, kind: str, name: str, value: float = 1.0, **labels):
@@ -148,14 +198,80 @@ class FleetRouter:
             return 200, "text/plain; version=0.0.4", self.metrics.render()
         return None
 
+    # -- fleet observability endpoints (blocking peer fetches ride a
+    # worker thread; the loop keeps relaying) -------------------------------
+    async def _local_async(self, path: str):
+        """(status, content_type, body) for the fleet-scope routes that
+        must fan out HTTP to peers, or None to proxy."""
+        if path == "/metrics/fleet":
+            return await asyncio.to_thread(self._fleet_metrics_response)
+        if path == "/debug/slo":
+            return await asyncio.to_thread(self._fleet_slo_response)
+        if path.startswith("/debug/fleet/traces/"):
+            trace_id = path.rpartition("/")[2]
+            if not _TRACE_ID_RE.fullmatch(trace_id):
+                return 404, "application/json", json.dumps(
+                    {"detail": "malformed trace id"})
+            return await asyncio.to_thread(self._fleet_trace_response,
+                                           trace_id)
+        return None
+
+    def _scrape_peers(self) -> dict[str, str]:
+        texts: dict[str, str] = {}
+        for addr in self.peers.healthy():
+            text = fleettrace.fetch_text(addr, "/metrics",
+                                         timeout=self.proxy_timeout)
+            if text:
+                texts[addr] = text
+        return texts
+
+    def _federate(self) -> dict:
+        fed = fleettrace.federate(self._scrape_peers())
+        self._fleet_view.update(fed["snapshot"])
+        return fed
+
+    def _fleet_metrics_response(self):
+        fed = self._federate()
+        self._fleet_slo.export()
+        body = fed["exposition"] + self._fleet_view.render_gauges()
+        return 200, "text/plain; version=0.0.4", body
+
+    def _fleet_slo_response(self):
+        fed = self._federate()
+        doc = self._fleet_slo.evaluate()
+        doc["scope"] = "fleet"
+        doc["peers"] = fed["peers"]
+        return 200, "application/json", json.dumps(doc)
+
+    def _fleet_trace_response(self, trace_id: str):
+        local_doc = None
+        if self.tracer is not None:
+            tr = self.tracer.get(trace_id)
+            if tr is not None:
+                local_doc = tr.to_dict()
+        frags = fleettrace.collect_fragments(
+            trace_id, self.peers.healthy(), timeout=self.proxy_timeout,
+            local=local_doc)
+        doc = fleettrace.stitch(frags)
+        if doc is None:
+            return 404, "application/json", json.dumps(
+                {"detail": "trace not found on the router or any "
+                           "healthy peer"})
+        return 200, "application/json", json.dumps(doc)
+
     # -- one proxy attempt -------------------------------------------------
     async def _proxy_attempt(self, addr: str, head: bytes, body: bytes,
                              writer: asyncio.StreamWriter,
-                             sent: list) -> int:
+                             sent: list, span=None) -> int:
         """Forward one request to ``addr``, relaying the response to
         ``writer`` as it arrives.  ``sent`` flips truthy once the first
         response byte reaches the client (the no-retry point).  Returns
-        the backend status; raises :class:`_BackendError` otherwise."""
+        the backend status; raises :class:`_BackendError` otherwise.
+        ``span`` (the attempt span, None when sampled out) gets
+        ``response.head`` / ``stream.relay`` children — the relay span
+        ends at the LAST body byte.  Error paths leave them open on
+        purpose: the tracer's finish sweep closes them ``auto_closed``
+        at the abort instant."""
         host, _, port = addr.rpartition(":")
         try:
             r2, w2 = await asyncio.wait_for(
@@ -164,6 +280,8 @@ class FleetRouter:
         except (OSError, asyncio.TimeoutError) as e:
             raise _BackendError(f"connect: {type(e).__name__}: {e}")
         try:
+            sp_head = span.child("response.head") if span is not None \
+                else None
             w2.write(head + body)
             try:
                 await asyncio.wait_for(w2.drain(), self.proxy_timeout)
@@ -211,6 +329,12 @@ class FleetRouter:
             out.append(b"connection: close\r\n\r\n")
             writer.write(b"".join(out))
             sent.append(True)
+            sp_relay = None
+            relayed = 0
+            if sp_head is not None:
+                sp_head.set(status=status)
+                sp_head.end()
+                sp_relay = span.child("stream.relay")
             # relay the body VERBATIM (byte-identity is the contract),
             # tracking the backend's own framing to know where the
             # response ends — EOF alone is not a terminator for
@@ -249,6 +373,8 @@ class FleetRouter:
                     data = await _read(r2.readexactly(size + 2))
                     writer.write(data)
                     await writer.drain()
+                    if sp_relay is not None:
+                        relayed += len(size_line) + len(data)
                     if size == 0:
                         break
             elif content_length is not None:
@@ -262,6 +388,8 @@ class FleetRouter:
                     remaining -= len(chunk)
                     writer.write(chunk)
                     await writer.drain()
+                    if sp_relay is not None:
+                        relayed += len(chunk)
             else:
                 # no framing: the response ends when the backend closes
                 while True:
@@ -270,6 +398,13 @@ class FleetRouter:
                         break
                     writer.write(chunk)
                     await writer.drain()
+                    if sp_relay is not None:
+                        relayed += len(chunk)
+            if sp_relay is not None:
+                # ends AT the last relayed byte — the waterfall's relay
+                # bar is the stream's true client-visible extent
+                sp_relay.set(bytes=relayed)
+                sp_relay.end()
             return status
         finally:
             try:
@@ -341,12 +476,12 @@ class FleetRouter:
         if isinstance(body, str):
             body = body.encode()
         reason = {200: "OK", 503: "Service Unavailable",
-                  408: "Request Timeout",
+                  408: "Request Timeout", 404: "Not Found",
                   501: "Not Implemented"}.get(status, "")
-        extra = "".join(f"{k}: {v}\r\n"
+        extra = "".join(f"{k}: {sanitize_text(v, limit=256)}\r\n"
                         for k, v in (extra_headers or {}).items())
         writer.write(
-            f"HTTP/1.1 {status} {reason}\r\n"
+            f"HTTP/1.1 {status} {reason}\r\n"  # lfkt: sanitizes[http-request,wire-frame] -- the only request-derived value here is extra (x-request-id and friends), and every extra_headers value passes sanitize_text in the join above; status/reason/ctype/len are internal
             f"content-type: {ctype}\r\n"
             f"content-length: {len(body)}\r\n"
             f"{extra}"
@@ -373,29 +508,57 @@ class FleetRouter:
         method, target, headers, raw_headers, body = got
         path = target.partition("?")[0]
         local = self._local_response(path)
+        if local is None:
+            local = await self._local_async(path)
         if local is not None:
             self._write_simple(writer, *local)
             await writer.drain()
             return
 
+        # trace identity: ingest the inbound traceparent (re-validated —
+        # only a well-formed one survives as hex, so it can ride logs and
+        # outbound headers without a declassifier) or mint a fresh one
+        trace = None
+        if self.tracer is not None:
+            trace = self.tracer.start("fleet.route",
+                                      traceparent=headers.get("traceparent"))
+        inbound = parse_traceparent(headers.get("traceparent"))
+        rid = trace.trace_id if trace is not None else (
+            inbound[0] if inbound else uuid.uuid4().hex)
+        inbound_tp = f"00-{inbound[0]}-{inbound[1]}-01" if inbound else None
+        with bind_request_id(rid):
+            try:
+                await self._route(method, target, path, headers,
+                                  raw_headers, body, writer, trace,
+                                  inbound_tp, rid)
+            finally:
+                if self.tracer is not None:
+                    self.tracer.finish(trace)
+
+    async def _route(self, method, target, path, headers, raw_headers,
+                     body, writer, trace, inbound_tp, rid) -> None:
         key, source = affinity_key(path, headers, body)
         order = self.rank(key)
         owner = order[0] if order else None
         # forward the request with hop-by-hop headers rewritten: the
         # backend sees connection: close (EOF = end of response) and an
-        # exact content-length; everything else (traceparent, affinity
-        # header, content-type) passes through.  The head is rebuilt per
-        # ATTEMPT: the migration stamps below name the peer being tried
+        # exact content-length; everything else (affinity header,
+        # content-type) passes through.  traceparent is lifted out and
+        # re-appended per ATTEMPT: when traced it names the attempt span
+        # (the hop stamp fragments graft under), when sampled out the
+        # validated inbound value relays unchanged.  The head is rebuilt
+        # per ATTEMPT: the migration stamps below name the peer tried
         base = []
         for line in raw_headers:
             lname = line.split(b":", 1)[0].strip().lower()
             if lname in _HOP_HEADERS + (b"content-length", b"host",
+                                        b"traceparent",
                                         AFFINITY_KEY_HEADER.encode(),
                                         PRIOR_OWNER_HEADER.encode()):
                 continue
             base.append(line)
 
-        def build_head(addr: str) -> bytes:
+        def build_head(addr: str, span=None) -> bytes:
             fwd = [f"{method} {target} HTTP/1.1\r\n".encode()]  # lfkt: sanitizes[http-request] -- method/target are readline-framed: no LF can survive request-line parsing, so they cannot splice a header
             fwd.extend(base)
             fwd.append(f"host: {addr}\r\n".encode())
@@ -419,14 +582,28 @@ class FleetRouter:
                 if prior is not None:
                     fwd.append(
                         f"{PRIOR_OWNER_HEADER}: {prior}\r\n".encode())
+            hop_tp = span_traceparent(span) or inbound_tp
+            if hop_tp:
+                fwd.append(f"traceparent: "
+                           f"{sanitize_text(hop_tp, limit=64)}\r\n".encode())
             fwd.append(b"connection: close\r\n\r\n")
             return b"".join(fwd)
 
+        spath = sanitize_text(path, limit=256)
+        if trace is not None:
+            trace.root.set(method=sanitize_text(method, limit=16),
+                           path=spath, policy=self.policy, source=source)
+            trace.event("peer_pick", owner=owner,
+                        ranked=len(order),
+                        healthy=len(self.peers.healthy()))
         sent: list = []
         t0 = time.time()
         spills = 0
+        attempt_n = 0
         for addr in order:
             if not self.peers.is_healthy(addr):
+                if trace is not None:
+                    trace.event("peer_skipped", peer=addr)
                 continue
             if spills > self.max_spills:
                 # retry budget (LFKT_FLEET_MAX_SPILLS): a request that
@@ -441,16 +618,35 @@ class FleetRouter:
                                           f"{spills} failed replays "
                                           "(LFKT_FLEET_MAX_SPILLS)"}),
                     {"retry-after": max(
-                        1, int(self.peers.backoff_seconds))})
+                        1, int(self.peers.backoff_seconds)),
+                     "x-request-id": rid})
                 await writer.drain()
                 return
+            attempt_n += 1
+            attempt = None
+            if trace is not None:
+                attempt = trace.span("proxy.attempt")
+                attempt.set(peer=addr, n=attempt_n,
+                            owner=(addr == owner))
             try:
-                await self._proxy_attempt(addr, build_head(addr), body,
-                                          writer, sent)
+                status = await self._proxy_attempt(
+                    addr, build_head(addr, attempt), body, writer, sent,
+                    span=attempt)
             except _BackendError as e:
+                reason = sanitize_text(e.reason, limit=256)
+                if attempt is not None:
+                    attempt.set(error=reason, mid_stream=e.mid_stream)
+                    attempt.end()
                 self.peers.eject(addr, f"proxy {e.reason}")
                 self._emit("set_gauge", "fleet_peers_healthy",
                            len(self.peers.healthy()))
+                access_logger.info(
+                    "fleet attempt failed: %s", reason,
+                    extra={"route": spath,
+                           "method": sanitize_text(method, limit=16),
+                           "duration_s": round(time.time() - t0, 6),
+                           "peer": addr, "spills": spills,
+                           "attempt": attempt_n})
                 if sent:
                     # bytes already reached the client: the router cannot
                     # replay a partially delivered response — close, and
@@ -458,6 +654,8 @@ class FleetRouter:
                     self.counters["mid_stream_aborts"] += 1
                     self._emit("inc", "fleet_spills_total",
                                reason="mid_stream_abort")
+                    if trace is not None:
+                        trace.event("mid_stream_abort", peer=addr)
                     logger.warning("fleet: %s died mid-response for key "
                                    "%s; client connection closed", addr,
                                    key[:16])
@@ -465,12 +663,25 @@ class FleetRouter:
                 self.counters["spills"] += 1
                 self._emit("inc", "fleet_spills_total", reason="ejected")
                 spills += 1
+                if trace is not None:
+                    trace.event("spill", peer=addr, reason=reason)
                 continue
             # success
+            if attempt is not None:
+                attempt.set(status=status)
+                attempt.end()
             self.counters["proxied"] += 1
             self._emit("inc", "fleet_requests_total", peer=addr,
                        source=source)
             self._emit("observe", "fleet_proxy_seconds", time.time() - t0)
+            access_logger.info(
+                "fleet proxied", extra={
+                    "route": spath,
+                    "method": sanitize_text(method, limit=16),
+                    "status": status,
+                    "duration_s": round(time.time() - t0, 6),
+                    "peer": addr, "spills": spills,
+                    "attempt": attempt_n})
             if self.policy == "affinity" and addr != owner:
                 # served, but off the rendezvous owner: the owner is
                 # ejected and this request warmed its spill target
@@ -485,7 +696,8 @@ class FleetRouter:
             writer, 503, "application/json",
             json.dumps({"detail": "no healthy replica (fleet router); "
                                   "see the router's /health for per-peer "
-                                  "attribution"}))
+                                  "attribution"}),
+            {"x-request-id": rid})
         await writer.drain()
 
     # -- serving -----------------------------------------------------------

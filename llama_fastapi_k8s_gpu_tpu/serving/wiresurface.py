@@ -112,6 +112,11 @@ FIELDS: tuple[WireField, ...] = (
     WireField("deadline", "REQ", "peer-only",
               "absolute wall deadline; both sides abandon the transfer "
               "past it"),
+    WireField("trace", "REQ", "peer-only",
+              "W3C traceparent of the originating request (None when "
+              "sampled out); the serving side opens a span tree linked "
+              "to the same request id — never trusted for anything but "
+              "trace correlation"),
     WireField("seq", "PAGE", "peer-only",
               "page-group sequence number within one transfer"),
     WireField("n_pages", "PAGE|DONE", "peer-only",
@@ -145,9 +150,9 @@ FIELDS: tuple[WireField, ...] = (
 
 
 INGRESSES: tuple[WireIngress, ...] = (
-    WireIngress("serving.fleet.router:FleetRouter._handle_inner",
+    WireIngress("serving.fleet.router:FleetRouter._route",
                 "_proxy_attempt",
-                "the fleet router's client-facing accept loop: raw "
+                "the fleet router's client-facing routing path: raw "
                 "request bytes in, proxied verbatim to a replica after "
                 "the internal-stamp strip"),
 )

@@ -594,3 +594,81 @@ def test_two_process_page_streaming_drill(tmp_path):
                 p.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+# ---------------------------------------------------------------------------
+# layer 4: cross-process trace completeness (ISSUE 19 fleet observability)
+# ---------------------------------------------------------------------------
+
+def test_loopback_span_tree_completeness(gguf_path):
+    """The disagg REQ's wire-level trace context (schema-2 ``trace``
+    field): a traced prefetch makes the SERVER open a linked span tree
+    under the SAME trace id — with engine.prefill and the wire.send
+    kv_pages events — and stitching the two per-process fragments
+    yields one tree with zero orphans."""
+    from llama_fastapi_k8s_gpu_tpu.obs import fleettrace
+    from llama_fastapi_k8s_gpu_tpu.obs.trace import Tracer
+
+    tr_cli = Tracer(sample=1.0, ring=8)
+    tr_srv = Tracer(sample=1.0, ring=8)
+    eng_p = _engine(gguf_path)
+    eng_d = _engine(gguf_path)
+    srv = PrefillServer(eng_p, host="127.0.0.1", port=0, tracer=tr_srv)
+    cli = DisaggClient(f"127.0.0.1:{srv.port}", eng_d._kvpool,
+                       timeout_s=60.0)
+    try:
+        ids = eng_d.tokenize_messages(
+            [{"role": "user", "content": MSG_A}])
+        trace = tr_cli.start("request")
+        sp = trace.span("disagg")
+        covered = cli.prefetch(ids, span=sp)
+        sp.end()
+        tr_cli.finish(trace)
+        assert covered > 0                  # the hop genuinely fired
+
+        # ONE trace id across both processes: start_linked ingested the
+        # REQ's traceparent, so the server's tree shares the client's id
+        rid = trace.trace_id
+        srv_trace = tr_srv.get(rid)
+        assert srv_trace is not None, "server opened no linked tree"
+        srv_doc = srv_trace.to_dict()
+        assert srv_doc["root"]["name"] == "disagg.prefill"
+        assert srv_doc["root"]["attrs"]["tokens"] == len(ids)
+        assert covered <= len(ids)
+        names = {s["name"] for s, _ in _walk(srv_doc["root"])}
+        assert {"engine.prefill", "wire.send"} <= names
+        sends = [s for s, _ in _walk(srv_doc["root"])
+                 if s["name"] == "wire.send"]
+        evs = [e for e in sends[0].get("events", ())
+               if e["name"] == "kv_pages"]
+        assert evs and sum(e["pages"] for e in evs) \
+            == sends[0]["attrs"]["pages"]
+        assert sends[0]["attrs"]["bytes"] > 0
+
+        # the client fragment carries the dial handshake event
+        cli_doc = trace.to_dict()
+        cevs = [e for s, _ in _walk(cli_doc["root"])
+                for e in s.get("events", ()) if e["name"] == "handshake"]
+        assert len(cevs) == 1 and cevs[0]["peer"] == f"127.0.0.1:{srv.port}"
+
+        # stitch: decode fragment primary, prefill fragment grafts under
+        # the disagg span that stamped the REQ — zero orphans
+        doc = fleettrace.stitch([
+            {"peer": "decode", "doc": cli_doc},
+            {"peer": "prefill", "doc": srv_doc},
+        ])
+        assert doc["trace_id"] == rid
+        assert doc["orphans"] == [] and doc["fragments"] == 2
+        assert doc["root"]["name"] == "request"
+        grafted = [s for s, _ in _walk(doc["root"])
+                   if (s.get("attrs") or {}).get("process") == "prefill"]
+        assert len(grafted) == 1 and grafted[0]["attrs"]["hop"] is True
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def _walk(span, depth=0):
+    yield span, depth
+    for child in span.get("children", ()):
+        yield from _walk(child, depth + 1)

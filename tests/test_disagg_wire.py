@@ -170,3 +170,11 @@ def test_wire_schema_golden_header_is_pinned():
         "disagg wire schema drifted from docs/disagg_wire_schema.json — "
         "bump WIRE_SCHEMA and regenerate the golden deliberately")
     assert wire.schema_descriptor()["wire_schema"] == wire.WIRE_SCHEMA
+
+
+def test_schema_2_req_carries_trace_context():
+    """Schema 2 (ISSUE 19): the REQ descriptor names the ``trace``
+    field — the wire-level traceparent hop that lets the prefill tier
+    open a linked span tree — and the bump is deliberate, not drift."""
+    assert wire.WIRE_SCHEMA == 2
+    assert "trace" in wire.schema_descriptor()["headers"]["REQ"]

@@ -40,6 +40,8 @@ import urllib.request
 import pytest
 
 from llama_fastapi_k8s_gpu_tpu.engine import Engine, FakeEngine
+from llama_fastapi_k8s_gpu_tpu.obs import fleettrace
+from llama_fastapi_k8s_gpu_tpu.obs.trace import Span, Tracer
 from llama_fastapi_k8s_gpu_tpu.server import httpd
 from llama_fastapi_k8s_gpu_tpu.server.app import create_app
 from llama_fastapi_k8s_gpu_tpu.serving.fleet import FLEET_ROLES, build_router
@@ -140,10 +142,11 @@ class _Served:
         self._thread.join(timeout=join_s)
 
 
-def _serve_app(engine, port: int, **settings_kw) -> _Served:
+def _serve_app(engine, port: int, tracer=None, **settings_kw) -> _Served:
     settings_kw.setdefault("watchdog", False)
     settings_kw.setdefault("temperature", 0.0)
-    app = create_app(engine=engine, settings=Settings(**settings_kw))
+    app = create_app(engine=engine, settings=Settings(**settings_kw),
+                     tracer=tracer)
     srv = _Served(lambda stop: httpd.serve(app, "127.0.0.1", port,
                                            stop_event=stop))
     _wait_http(port)
@@ -851,3 +854,257 @@ def test_two_process_affinity_and_fault_drill(tmp_path):
                 p.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+# ---------------------------------------------------------------------------
+# layer 5: fleet observability (ISSUE 19) — cross-process trace
+# continuity (the ci_gate ``fleet-trace-continuity`` subset matches
+# ``-k trace_continuity``), metrics federation, zero-cost sampling
+# ---------------------------------------------------------------------------
+
+def _load_tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _find_spans(root: dict, name: str) -> list[dict]:
+    out = []
+    stack = [root]
+    while stack:
+        sp = stack.pop()
+        if sp.get("name") == name:
+            out.append(sp)
+        stack.extend(sp.get("children", ()))
+    return out
+
+
+def test_fleet_trace_continuity_sse(tmp_path):
+    """THE cross-process tracing drill (the ci_gate subset): one traced
+    streamed ``/v1`` request through the real router and a REAL replica
+    process yields ONE request id end-to-end and ONE stitched span tree
+    spanning both processes with zero orphan fragments — including the
+    router's ``stream.relay`` span ending at the last relayed byte —
+    and the waterfall renderer draws the hop boundary."""
+    write_tiny_llama_gguf(str(tmp_path / "tiny.gguf"))
+    p1, rp = _free_port(), _free_port()
+    proc = _spawn_replica(p1, str(tmp_path), LFKT_TRACE_SAMPLE=1,
+                          LFKT_TRACE_RING=16)
+    table = rs = None
+    try:
+        _wait_proc_ready(proc, p1, time.time() + 420)
+        table = _table([p1]).start()
+        router = FleetRouter(table, policy="affinity", metrics=Metrics(),
+                             tracer=Tracer(sample=1.0, ring=16))
+        rs = _serve_router(router, rp)
+
+        body = json.dumps({
+            "model": None, "temperature": 0.0, "max_tokens": 8,
+            "stream": True, "user": "conv-trace-1",
+            "messages": [{"role": "user",
+                          "content": "Say something about foxes."}],
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rp}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as r:
+            rid = r.headers.get("x-request-id")
+            tp = r.headers.get("traceparent")
+            sse = r.read()
+        assert sse and b"data:" in sse and b"[DONE]" in sse
+
+        # ONE request id end-to-end: the replica ingested the router's
+        # hop traceparent, so the id the CLIENT sees (relayed replica
+        # headers) is the ROUTER's trace id
+        assert rid is not None and len(rid) == 32, rid
+        assert tp is not None and tp.split("-")[1] == rid
+        assert router.tracer.get(rid) is not None
+
+        # the stitched tree: poll until the replica's fragment reports
+        # finished (its SSE generator closes the trace at stream end)
+        doc = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            doc = _get_json(rp, f"/debug/fleet/traces/{rid}")
+            if doc.get("fragments", 0) >= 2 and doc.get("finished"):
+                break
+            time.sleep(0.3)
+        assert doc is not None and doc["trace_id"] == rid
+        assert doc["stitched"] is True
+        assert doc["fragments"] >= 2, doc["processes"]
+        assert "router" in doc["processes"]
+        assert f"127.0.0.1:{p1}" in doc["processes"]
+        assert doc["orphans"] == [], doc["orphans"]
+
+        # the router fragment is primary; the replica fragment grafts
+        # under the proxy attempt that carried its hop traceparent
+        assert doc["root"]["name"] == "fleet.route"
+        attempts = _find_spans(doc["root"], "proxy.attempt")
+        assert attempts and attempts[0]["attrs"]["peer"] == \
+            f"127.0.0.1:{p1}"
+        replica_roots = [sp for sp in _find_spans(doc["root"], "request")
+                         if sp.get("attrs", {}).get("process")
+                         == f"127.0.0.1:{p1}"]
+        assert len(replica_roots) == 1
+        assert replica_roots[0]["attrs"].get("hop") is True
+
+        # stream.relay ends AT the last relayed byte, with the byte
+        # count — raw wire bytes, so chunked framing makes it >= the
+        # decoded body urllib handed back
+        relays = _find_spans(doc["root"], "stream.relay")
+        assert len(relays) == 1
+        assert relays[0]["end"] is not None
+        assert not relays[0]["attrs"].get("auto_closed")
+        assert relays[0]["attrs"]["bytes"] >= len(sse) > 0
+
+        # the waterfall renderer draws the stitched tree with the hop rule
+        text = _load_tool("trace_report").render_trace(doc)
+        assert "hop: 127.0.0.1:" in text
+        assert "stream.relay" in text
+        assert "processes=router,127.0.0.1:" in text
+
+        # routerless assembly (tools/fleet_trace.py path): collecting
+        # straight from the pods stitches the same tree minus the router
+        # fragment — whose absence makes the replica fragment primary
+        frags = fleettrace.collect_fragments(rid, [f"127.0.0.1:{p1}"])
+        assert len(frags) == 1
+        alone = fleettrace.stitch(frags)
+        assert alone["trace_id"] == rid and alone["orphans"] == []
+    finally:
+        if rs is not None:
+            rs.stop()
+        if table is not None:
+            table.stop()
+        if proc.poll() is None:
+            proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_fleet_metrics_federation_exact_merge():
+    """``GET /metrics/fleet`` merges peer scrapes EXACTLY: every fleet
+    counter equals the sum of the per-pod series, every histogram
+    bucket/sum/count equals the bucket-wise sum, gauges re-label by
+    peer, and the SLO engine's fleet-scope burn gauges ride the body."""
+    p1, p2, rp = (_free_port() for _ in range(3))
+    s1 = _serve_app(FakeEngine(reply="alpha"), p1)
+    s2 = _serve_app(FakeEngine(reply="beta"), p2)
+    m = Metrics()      # shared router+prober registry, as build_router wires
+    table = _table([p1, p2], metrics=m).start()
+    router = FleetRouter(table, policy="roundrobin", metrics=m)
+    rs = _serve_router(router, rp)
+    try:
+        for conv in range(6):
+            status, _raw = _post(rp, _body(conv))
+            assert status == 200
+        # quiesce, then scrape pods and fleet back-to-back (no traffic
+        # in between: the merge must reproduce the pod sums exactly)
+        def scrape(port, path="/metrics"):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+                return r.read().decode()
+
+        pod1 = fleettrace.parse_exposition(scrape(p1))
+        pod2 = fleettrace.parse_exposition(scrape(p2))
+        body = scrape(rp, "/metrics/fleet")
+        fleet = fleettrace.parse_exposition(body)
+
+        # the scrapes themselves hit each pod's /metrics, so that one
+        # route's series keeps moving between our reads — every OTHER
+        # series is quiescent and must merge EXACTLY
+        def moving(key) -> bool:
+            return ("route", "/metrics") in key
+
+        # counters: fleet series == sum of pod series
+        fam = "http_requests_total"
+        compared = 0
+        for key, val in fleet[fam]["series"].items():
+            if moving(key):
+                continue
+            compared += 1
+            expect = (pod1.get(fam, {}).get("series", {}).get(key, 0.0)
+                      + pod2.get(fam, {}).get("series", {}).get(key, 0.0))
+            assert val == expect, (key, val, expect)
+        assert compared >= 1
+        total = sum(v for k, v in fleet[fam]["series"].items()
+                    if not moving(k))
+        assert total >= 6.0
+
+        # histograms: bucket-wise cumulative counts add exactly
+        fam = "request_seconds"
+        assert fleet[fam]["type"] == "histogram"
+        for key, h in fleet[fam]["hist"].items():
+            if moving(key):
+                continue
+            h1 = pod1.get(fam, {}).get("hist", {}).get(
+                key, {"le": {}, "sum": 0.0, "count": 0.0})
+            h2 = pod2.get(fam, {}).get("hist", {}).get(
+                key, {"le": {}, "sum": 0.0, "count": 0.0})
+            assert h["count"] == h1["count"] + h2["count"]
+            assert abs(h["sum"] - (h1["sum"] + h2["sum"])) < 1e-9
+            for le, cum in h["le"].items():
+                assert cum == (h1["le"].get(le, 0.0)
+                               + h2["le"].get(le, 0.0)), (key, le)
+
+        # gauges re-label by peer — never summed
+        assert f'queue_depth{{peer="127.0.0.1:{p1}"}}' in body
+        assert f'queue_depth{{peer="127.0.0.1:{p2}"}}' in body
+
+        # the fleet-scope SLO verdict rides the same body + /debug/slo
+        assert 'slo_burn_rate{' in body and 'scope="fleet"' in body
+        doc = _get_json(rp, "/debug/slo")
+        assert doc["scope"] == "fleet"
+        assert set(doc["peers"]) == {f"127.0.0.1:{p1}",
+                                     f"127.0.0.1:{p2}"}
+        assert doc["slos"]
+
+        # satellite: the router's OWN /metrics carries the probe-latency
+        # histogram, labeled per peer (peers.py observes every round trip)
+        own = scrape(rp)
+        assert f'fleet_probe_seconds_bucket{{peer="127.0.0.1:{p1}"' in own
+        assert "fleet_probe_seconds_count" in own
+    finally:
+        rs.stop()
+        table.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_router_relay_sampled_out_builds_no_spans(monkeypatch):
+    """The zero-cost contract at fleet scope: with LFKT_TRACE_SAMPLE=0
+    on both sides, a routed request (stream relay included) constructs
+    ZERO Span objects in either process — pinned by poisoning the Span
+    constructor, the test_obs idiom."""
+    p1, rp = _free_port(), _free_port()
+    s1 = _serve_app(FakeEngine(reply="alpha"), p1,
+                    tracer=Tracer(sample=0.0, ring=4))
+    table = _table([p1]).start()
+    router = FleetRouter(table, policy="affinity", metrics=Metrics(),
+                         tracer=Tracer(sample=0.0, ring=4))
+    rs = _serve_router(router, rp)
+    try:
+        def poisoned(self, *a, **kw):
+            raise AssertionError(
+                "Span constructed on the sampled-out fleet path")
+
+        monkeypatch.setattr(Span, "__init__", poisoned)
+        status, raw = _post(rp, _body(0))
+        assert status == 200
+        assert json.loads(raw)["response"] == "alpha"
+        # and the request id still exists for log joining (a uuid, not
+        # a trace id — no tracer allocation behind it)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rp}/response", data=_body(1),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers.get("x-request-id")
+    finally:
+        rs.stop()
+        table.stop()
+        s1.stop()

@@ -449,4 +449,4 @@ def test_kinds_are_closed_set(recorder):
     assert recorder.record("made_up_kind", "r") is None
     assert set(KINDS) == {"watchdog_trip", "dead_escalation",
                           "resource_exhausted", "slo_breach",
-                          "disagg_peer_dead"}
+                          "disagg_peer_dead", "fleet_peer_ejected"}

@@ -350,12 +350,16 @@ def test_pr17_strip_removal_fires_wire002(tmp_path):
     src = router.read_text()
     strip = ('_HOP_HEADERS + (b"content-length", b"host",\n'
              '                                        '
+             'b"traceparent",\n'
+             '                                        '
              'AFFINITY_KEY_HEADER.encode(),\n'
              '                                        '
              'PRIOR_OWNER_HEADER.encode())')
     assert strip in src, "router strip shape moved; update this pin"
     router.write_text(src.replace(
-        strip, '_HOP_HEADERS + (b"content-length", b"host")'))
+        strip, '_HOP_HEADERS + (b"content-length", b"host",\n'
+               '                                        '
+               'b"traceparent")'))
     findings = run_lint(package_dir=str(pkg), rules={"WIRE002"})
     hits = [f for f in findings
             if f.rule == "WIRE002" and "router.py" in f.path
@@ -671,7 +675,7 @@ def test_ci_gate_aggregates_lint_and_manifest():
     import json
 
     pytest_checks = {"decode-loop-parity", "fleet-route-parity",
-                     "chaos-drill"}
+                     "chaos-drill", "fleet-trace-continuity"}
     dup_checks = {"lfkt-lint", "lint-concurrency", "lint-taint"}
     proc = subprocess.run(
         [sys.executable, "tools/ci_gate.py", "--json",
@@ -684,7 +688,8 @@ def test_ci_gate_aggregates_lint_and_manifest():
     assert names == {"lfkt-lint", "lint-concurrency", "lint-taint",
                      "check-manifest", "incident-schema",
                      "disagg-wire-schema", "decode-loop-parity",
-                     "fleet-route-parity", "chaos-drill"}
+                     "fleet-route-parity", "chaos-drill",
+                     "fleet-trace-continuity"}
     assert all(c["exit"] == 0 for c in doc["checks"])
     assert {c["name"] for c in doc["checks"]
             if c.get("skipped")} == pytest_checks | dup_checks

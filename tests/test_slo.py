@@ -222,7 +222,7 @@ def test_export_publishes_burn_rate_gauges():
     text = m.render()
     line = next(ln for ln in text.splitlines()
                 if ln.startswith('slo_burn_rate{slo="queue_p95"'
-                                 ',window="60s"}'))
+                                 ',window="60s",scope="pod"}'))
     assert float(line.split()[-1]) >= 1.0
 
 

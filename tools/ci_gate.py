@@ -99,6 +99,17 @@ CHECKS: list[tuple[str, list[str]]] = [
                      "-m", "pytest", "-q", "-p", "no:cacheprovider",
                      os.path.join(ROOT, "tests", "test_chaos.py"),
                      "-k", "smoke"]),
+    # cross-process trace continuity (ISSUE 19): one traced request
+    # through the real router + replica yields ONE stitched span tree
+    # spanning both processes with zero orphan fragments — the guard
+    # keeping every future hop (proxy header, wire REQ field) honest
+    # about propagating trace context instead of silently dropping it.
+    ("fleet-trace-continuity", ["env", "JAX_PLATFORMS=cpu", sys.executable,
+                                "-m", "pytest", "-q", "-p",
+                                "no:cacheprovider",
+                                os.path.join(ROOT, "tests",
+                                             "test_fleet.py"),
+                                "-k", "trace_continuity"]),
 ]
 
 

@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""fleet_trace — pull one request's trace fragments from every process
+that touched it and render the stitched cross-process waterfall.
+
+The RUNBOOK's fleet-triage flow ("Tracing a request across the fleet",
+docs/RUNBOOK.md): a request id (= trace id, the router's x-request-id
+response header) names spans in the ROUTER (peer pick, spills, retries,
+stream relay), the OWNING REPLICA (admission, queue, prefill, decode),
+and — when disagg or migration fired — the PREFILL/WARM peers' wire
+serves.  Each process only knows its own fragment; this tool assembles
+them (obs/fleettrace.py ``stitch``) and renders one waterfall with hop
+boundaries via tools/trace_report.py.
+
+Usage::
+
+    # the easy path: ask the router, which collects from its peers
+    python tools/fleet_trace.py --router http://router:8080 --trace <id>
+
+    # routerless: name the pods yourself (host:port, comma separated)
+    python tools/fleet_trace.py --peers 10.0.0.4:8000,10.0.0.5:8000 \
+        --trace <id>
+
+    # raw stitched JSON instead of the waterfall (pipe to a file/jq)
+    python tools/fleet_trace.py --router http://router:8080 --trace <id> \
+        --json
+
+stdlib only, no jax import — safe on a serving pod or a laptop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))   # the repo root (package)
+sys.path.insert(0, _HERE)                    # sibling tools modules
+
+import trace_report  # noqa: E402
+from llama_fastapi_k8s_gpu_tpu.obs import fleettrace  # noqa: E402
+
+_TRACE_ID_RE = re.compile(r"[0-9a-f]{32}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet_trace")
+    ap.add_argument("--trace", required=True,
+                    help="trace id (= request id / x-request-id)")
+    ap.add_argument("--router",
+                    help="router base URL — it collects from its peers")
+    ap.add_argument("--peers",
+                    help="host:port,host:port — collect directly")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    ap.add_argument("--json", action="store_true",
+                    help="print the stitched document, not the waterfall")
+    args = ap.parse_args(argv)
+
+    trace_id = args.trace.strip().lower()
+    if _TRACE_ID_RE.fullmatch(trace_id) is None:
+        print(f"fleet_trace: {args.trace!r} is not a trace id "
+              "(32 lowercase hex chars)", file=sys.stderr)
+        return 2
+
+    if args.router:
+        # the router stitches: it knows the peer set and holds its own
+        # fragment (the hop spans) — one GET does the whole assembly
+        base = args.router.rstrip("/")
+        host = base.split("//", 1)[-1].split("/", 1)[0]
+        doc = fleettrace.fetch_json(
+            host, f"/debug/fleet/traces/{trace_id}", timeout=args.timeout)
+        if doc is None:
+            print(f"fleet_trace: no stitched trace for {trace_id} at "
+                  f"{base} (sampled out, expired from the rings, or the "
+                  "router is unreachable)", file=sys.stderr)
+            return 1
+    elif args.peers:
+        peers = [p.strip() for p in args.peers.split(",") if p.strip()]
+        frags = fleettrace.collect_fragments(trace_id, peers,
+                                             timeout=args.timeout)
+        doc = fleettrace.stitch(frags)
+        if doc is None:
+            print(f"fleet_trace: no fragment of {trace_id} on any of "
+                  f"{len(peers)} peer(s)", file=sys.stderr)
+            return 1
+    else:
+        ap.error("one of --router or --peers is required")
+        return 2
+
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    print(trace_report.render_trace(doc))
+    if doc.get("orphans"):
+        print()
+        print(f"WARNING: {len(doc['orphans'])} orphan fragment(s) — a "
+              "process produced spans for this id whose parent span is "
+              "missing (its pod's ring may have evicted the parent)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,10 @@ Usage::
     # offline: a saved /debug/traces/<id> (or /debug/traces) JSON document
     python tools/trace_report.py --file trace.json
 
+Cross-process (fleet) waterfalls: point --file at a saved stitched
+document, or use ``tools/fleet_trace.py`` which collects the fragments
+from the router/peers and renders through the same code.
+
 stdlib only (urllib), no jax import — safe on a serving pod.
 """
 
@@ -61,9 +65,13 @@ def _fmt_bytes(b) -> str:
 def render_trace(trace: dict) -> str:
     """One trace's ASCII waterfall + phase percentages.
 
-    ``trace`` is the /debug/traces/{id} document (trace_id, meta, root).
-    Spans with no end (request still in flight / producer died) render to
-    the trace's horizon with a ``…`` marker.
+    ``trace`` is the /debug/traces/{id} document (trace_id, meta, root)
+    — or a STITCHED fleet document (router ``/debug/fleet/traces/{id}``,
+    obs/fleettrace.py): grafted fragment roots carry ``process``/``hop``
+    attrs and render behind a hop-boundary rule, so one waterfall shows
+    the router, the owning replica, and the prefill/migration tiers on
+    one clock.  Spans with no end (request still in flight / producer
+    died) render to the trace's horizon with a ``…`` marker.
     """
     root = trace["root"]
     t0 = root["start"]
@@ -77,6 +85,10 @@ def render_trace(trace: dict) -> str:
     for k in ("route", "engine", "lane", "status"):
         if meta.get(k) is not None:
             head.append(f"{k}={meta[k]}")
+    if trace.get("stitched"):
+        head.append(f"processes={','.join(trace.get('processes') or [])}")
+        if trace.get("orphans"):
+            head.append(f"orphans={len(trace['orphans'])}")
     lines.append("  ".join(head))
     lines.append(f"total {total * 1000.0:.1f} ms"
                  + ("" if root.get("end") else "  (in flight)"))
@@ -85,6 +97,15 @@ def render_trace(trace: dict) -> str:
     #: name | start-ms | dur-ms | timeline bar
     phase_seconds: dict[str, float] = {}
     for span, depth in _walk(root):
+        attrs = span.get("attrs") or {}
+        if attrs.get("process") is not None:
+            # a stitched fragment's root: everything under this line ran
+            # in ANOTHER process, linked by the wire/header hop named here
+            label = f"─ hop: {attrs['process']}"
+            if attrs.get("orphan"):
+                label += " (orphan)"
+            lines.append(f"{label[:NAME_COL]:<{NAME_COL}} {'':>6} {'':>6} "
+                         f"|{'┈' * WIDTH}|")
         start = span["start"] - t0
         end = (span.get("end") or horizon) - t0
         dur = max(end - start, 0.0)
@@ -133,14 +154,17 @@ def render_trace(trace: dict) -> str:
                     suffix += f" {_fmt_bytes(ev['bytes'])}"
                 duration_bar(at, host_s, "░", ev["name"], suffix)
                 continue
-            if ev["name"] in ("disagg_recv", "kv_migrate_pull") \
-                    and host_s is not None:
+            if ev["name"] in ("disagg_recv", "kv_migrate_pull",
+                              "handshake") and host_s is not None:
                 # wire-delivered KV pages (▓): a disagg prefill transfer
                 # (serving/disagg/) or a fleet migration pull
                 # (serving/fleet/migrate.py) — the hop's cost next to
-                # the local restore/suffix-prefill it buys
+                # the local restore/suffix-prefill it buys; the dial
+                # handshake renders the same way (first-hop cost)
                 suffix = (f"pages={ev.get('pages', '?')}"
-                          f" t={ev.get('tokens', '?')}")
+                          f" t={ev.get('tokens', '?')}"
+                          if ev["name"] != "handshake"
+                          else f"peer={ev.get('peer', '?')}")
                 if ev.get("bytes") is not None:
                     suffix += f" {_fmt_bytes(ev['bytes'])}"
                 if ev.get("reason") is not None:
@@ -151,6 +175,11 @@ def render_trace(trace: dict) -> str:
             tick = " " * mark + "▲" + " " * (WIDTH - mark - 1)
             ename = (" " * ((depth + 1) * INDENT) + "* " + ev["name"])[:NAME_COL]
             suffix = ""
+            if ev["name"] == "kv_pages":
+                # serve-side wire.send progress marks (prefiller.py /
+                # migrate.py): one PAGE group on the wire per tick
+                suffix = (f"  pages={ev.get('pages', '?')}"
+                          f" {_fmt_bytes(ev.get('bytes'))}")
             if ev["name"] == "mem_pressure":
                 # lfkt-mem: the admission controller cut its budget on
                 # low HBM headroom — the byte counts explain the slower
